@@ -1,0 +1,20 @@
+"""lighthouse_tpu — a TPU-native Ethereum consensus-layer framework.
+
+A ground-up rebuild of the capabilities of the reference client (Lighthouse,
+``/root/reference``): SSZ types and the beacon state transition, fork choice, batched
+signature-verification pipelines, a back-pressured scheduler, storage, networking,
+validator client, and HTTP APIs — with the BLS12-381 batch-verification hot path
+executed as JAX/XLA kernels on TPU.
+
+Importing this package enables 64-bit JAX types: the big-integer limb kernels
+accumulate 16-bit-limb products in uint64 lanes.
+"""
+
+try:
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+except ImportError:  # the pure-Python oracle backend works without jax
+    pass
+
+__version__ = "0.1.0"

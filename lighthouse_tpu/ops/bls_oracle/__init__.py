@@ -1,0 +1,20 @@
+"""Pure-Python BLS12-381 oracle: the trusted reference + portable CPU backend.
+
+Role model: the reference's dual-backend BLS seam
+(``/root/reference/crypto/bls/src/lib.rs:8-18`` — blst vs fake_crypto). Every JAX/TPU
+kernel in ``lighthouse_tpu.ops.bls`` is validated against this package.
+"""
+
+from .fields import P, R, BLS_X, Fq2, Fq6, Fq12, fq_inv, fq_sqrt
+from .curves import (
+    g1_generator, g2_generator, g1_add, g2_add, g1_mul, g2_mul, g1_neg, g2_neg,
+    g1_is_on_curve, g2_is_on_curve, g1_in_subgroup, g2_in_subgroup,
+    g1_compress, g1_decompress, g2_compress, g2_decompress, g1_msm,
+)
+from .pairing import miller_loop, final_exponentiation, pairing, multi_pairing_is_one
+from .hash_to_curve import hash_to_curve_g2, expand_message_xmd, hash_to_field_fq2
+from .ciphersuite import (
+    DST, keygen_from_ikm, sk_to_pk, sign, verify, aggregate_pubkeys,
+    aggregate_signatures, fast_aggregate_verify, aggregate_verify,
+    SignatureSet, verify_signature_sets,
+)

@@ -1,0 +1,178 @@
+"""Oracle invariants: the algebraic identities that pin down BLS12-381.
+
+Mirrors the reference's BLS test tiers (``/root/reference/crypto/bls/tests/tests.rs``
+macro-instantiated round-trips + the ef_tests BLS handlers at
+``/root/reference/testing/ef_tests/src/cases/bls_*.rs``). With no spec vectors on
+disk, correctness rests on cross-validating independent constructions:
+bilinearity, fast-vs-naive final exponentiation, psi-vs-h_eff cofactor clearing,
+and sign/verify round-trips.
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.ops.bls_oracle import (
+    P, R, BLS_X, Fq2, Fq12,
+    g1_generator, g2_generator, g1_add, g2_add, g1_mul, g2_mul, g1_neg,
+    g1_in_subgroup, g2_in_subgroup, g2_is_on_curve,
+    g1_compress, g1_decompress, g2_compress, g2_decompress,
+    miller_loop, final_exponentiation, pairing, multi_pairing_is_one,
+    hash_to_curve_g2, DST, keygen_from_ikm, sk_to_pk, sign, verify,
+    aggregate_signatures, fast_aggregate_verify, aggregate_verify,
+    SignatureSet, verify_signature_sets,
+)
+from lighthouse_tpu.ops.bls_oracle.pairing import final_exponentiation_naive
+from lighthouse_tpu.ops.bls_oracle.hash_to_curve import (
+    clear_cofactor_h_eff, clear_cofactor_psi, map_to_curve_sswu, iso_map,
+    is_on_iso_curve, psi,
+)
+from lighthouse_tpu.ops.bls_oracle.curves import B2
+
+rng = random.Random(0xB15)
+
+
+def rand_fr():
+    return rng.randrange(1, R)
+
+
+def rand_e2_point():
+    """Random point on E2 (full curve, not necessarily the subgroup)."""
+    while True:
+        x = Fq2(rng.randrange(P), rng.randrange(P))
+        y = (x.square() * x + B2).sqrt()
+        if y is not None:
+            return (x, y)
+
+
+class TestCurveGroups:
+    def test_generators_in_subgroup(self):
+        assert g1_in_subgroup(g1_generator())
+        assert g2_in_subgroup(g2_generator())
+
+    def test_scalar_mul_matches_addition(self):
+        g = g1_generator()
+        assert g1_mul(g, 5) == g1_add(g1_add(g1_add(g1_add(g, g), g), g), g)
+
+    def test_order(self):
+        assert g1_mul(g1_generator(), R) is None
+        assert g2_mul(g2_generator(), R) is None
+
+    def test_compress_roundtrip_g1(self):
+        for _ in range(4):
+            p = g1_mul(g1_generator(), rand_fr())
+            assert g1_decompress(g1_compress(p)) == p
+        assert g1_decompress(g1_compress(None)) is None
+
+    def test_compress_roundtrip_g2(self):
+        for _ in range(4):
+            p = g2_mul(g2_generator(), rand_fr())
+            assert g2_decompress(g2_compress(p)) == p
+        assert g2_decompress(g2_compress(None)) is None
+
+    def test_decompress_rejects_bad_x(self):
+        # x >= p must be rejected
+        with pytest.raises(ValueError):
+            g1_decompress(bytes([0x9F]) + b"\xff" * 47)
+        # find a deterministic x with no y on the curve
+        from lighthouse_tpu.ops.bls_oracle.fields import fq_sqrt
+
+        x = next(x for x in range(1, 64) if fq_sqrt((x * x * x + 4) % P) is None)
+        enc = bytearray(x.to_bytes(48, "big"))
+        enc[0] |= 0x80
+        with pytest.raises(ValueError):
+            g1_decompress(bytes(enc))
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        g1, g2 = g1_generator(), g2_generator()
+        e = pairing(g1, g2)
+        assert not e.is_one()
+        assert e.pow(R).is_one()
+        assert pairing(g1_mul(g1, 2), g2) == e * e
+        assert pairing(g1, g2_mul(g2, 2)) == e * e
+
+    def test_fast_final_exp_is_cube_of_naive(self):
+        m = miller_loop(g1_mul(g1_generator(), 7), g2_mul(g2_generator(), 11))
+        naive = final_exponentiation_naive(m)
+        assert final_exponentiation(m) == naive * naive * naive
+
+    def test_multi_pairing(self):
+        g1, g2 = g1_generator(), g2_generator()
+        a, b = rand_fr(), rand_fr()
+        ok = multi_pairing_is_one(
+            [(g1_mul(g1, a), g2_mul(g2, b)), (g1_neg(g1_mul(g1, a * b % R)), g2)]
+        )
+        assert ok
+        bad = multi_pairing_is_one(
+            [(g1_mul(g1, a), g2_mul(g2, b)), (g1_neg(g1_mul(g1, a * b % R + 1)), g2)]
+        )
+        assert not bad
+
+
+class TestHashToCurve:
+    def test_sswu_iso_land_on_curves(self):
+        u = Fq2(rng.randrange(P), rng.randrange(P))
+        q = map_to_curve_sswu(u)
+        assert is_on_iso_curve(q)
+        assert g2_is_on_curve(iso_map(q))
+
+    def test_cofactor_clearing_methods_agree(self):
+        p = rand_e2_point()
+        a, b = clear_cofactor_h_eff(p), clear_cofactor_psi(p)
+        assert a == b
+        assert g2_in_subgroup(a)
+
+    def test_psi_is_homomorphism(self):
+        p, q = rand_e2_point(), rand_e2_point()
+        assert psi(g2_add(p, q)) == g2_add(psi(p), psi(q))
+
+    def test_hash_to_curve_deterministic_subgroup(self):
+        h = hash_to_curve_g2(b"\x01" * 32, DST)
+        assert g2_in_subgroup(h)
+        assert h == hash_to_curve_g2(b"\x01" * 32, DST)
+        assert h != hash_to_curve_g2(b"\x02" * 32, DST)
+
+
+class TestCiphersuite:
+    def test_sign_verify_roundtrip(self):
+        sk = keygen_from_ikm(b"\x42" * 32)
+        pk = sk_to_pk(sk)
+        msg = b"\xab" * 32
+        sig = sign(sk, msg)
+        assert verify(pk, msg, sig)
+        assert not verify(pk, b"\xac" * 32, sig)
+        assert not verify(sk_to_pk(sk + 1), msg, sig)
+
+    def test_fast_aggregate_verify(self):
+        msg = b"\x11" * 32
+        sks = [keygen_from_ikm(bytes([i]) * 32) for i in range(1, 5)]
+        pks = [sk_to_pk(sk) for sk in sks]
+        agg = aggregate_signatures([sign(sk, msg) for sk in sks])
+        assert fast_aggregate_verify(pks, msg, agg)
+        assert not fast_aggregate_verify(pks[:3], msg, agg)
+
+    def test_aggregate_verify_distinct_messages(self):
+        sks = [keygen_from_ikm(bytes([i]) * 32) for i in range(1, 4)]
+        msgs = [bytes([i]) * 32 for i in range(1, 4)]
+        agg = aggregate_signatures([sign(sk, m) for sk, m in zip(sks, msgs)])
+        assert aggregate_verify([sk_to_pk(sk) for sk in sks], msgs, agg)
+
+    def test_verify_signature_sets_batch(self):
+        sets = []
+        for i in range(1, 4):
+            sk = keygen_from_ikm(bytes([i]) * 32)
+            msg = bytes([i ^ 0x5A]) * 32
+            sets.append(SignatureSet(sign(sk, msg), [sk_to_pk(sk)], msg))
+        assert verify_signature_sets(sets)
+        # poison one set -> whole batch fails
+        sets[1] = SignatureSet(sets[0].signature, sets[1].signing_keys, sets[1].message)
+        assert not verify_signature_sets(sets)
+
+    def test_aggregate_set_with_multiple_keys(self):
+        msg = b"\x77" * 32
+        sks = [keygen_from_ikm(bytes([i]) * 32) for i in range(9, 12)]
+        agg_sig = aggregate_signatures([sign(sk, msg) for sk in sks])
+        s = SignatureSet(agg_sig, [sk_to_pk(sk) for sk in sks], msg)
+        assert verify_signature_sets([s])
