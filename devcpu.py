@@ -1,0 +1,18 @@
+"""Import first in dev scripts to force CPU (avoids axon TPU client init).
+
+Usage: ``python -c "import devcpu, ..."`` or ``import devcpu`` at the top of a
+script run from the repo root. Tests get the same treatment from tests/conftest.py.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

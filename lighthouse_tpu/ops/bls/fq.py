@@ -1,0 +1,284 @@
+"""Fq (BLS12-381 base field) arithmetic as JAX limb kernels.
+
+Representation: little-endian 16-bit limbs in uint64 lanes, **25 limbs** (R = 2^400
+Montgomery domain), shape ``[..., 25]``. The 25th limb buys ~19 bits of headroom over
+the 381-bit modulus, which enables the two properties the whole kernel stack is built
+on:
+
+  * **Lazy addition/subtraction.** ``add``/``sub``/``neg`` are pure elementwise limb
+    ops — no carry propagation, no comparison, ~2 HLO ops each. Limbs grow beyond 16
+    bits and values beyond p; that's fine. The operand budget (enforced statically by
+    plans.lincomb) is: values < 600p and limbs < 2^22. Derivation: mont_mul needs
+    t = a*b < R*p, and 600p * 600p = 360000 p^2 < (2^400/p) * p^2 since
+    2^400/p > 2^18.7 > 360000; its REDC output is then t/R + p < 1.7p, made
+    canonical by one conditional subtract. The schoolbook convolution is exact for
+    limbs up to 2^22 (25 * 2^44 < 2^50 per uint64 accumulator). Convention: values
+    crossing a public tower-op boundary satisfy plans.PUB_BOUND (16-bit limbs,
+    value < 16p); lazy values live only between two Montgomery multiplies.
+    ``sub(a, b)``/``neg`` here require a *canonical* (< p) subtrahend: they add the
+    borrow-inflated constant 2p (every non-top limb rewritten >= 2^16 - 1). The
+    tower layer (plans/tower) uses bound-tracked inflated constants instead.
+
+  * **One normalization point.** ``mont_mul`` is the only place carries propagate
+    (three lax.scan walks: REDC, carry, conditional subtract), and its output is
+    canonical. Tower ops stack all their independent multiplies into one mont_mul
+    call (see tower.py), so a full Fq12 multiply costs a single scan-compiled kernel.
+
+Correctness is pinned against ``lighthouse_tpu.ops.bls_oracle`` on random inputs.
+This layer is the TPU twin of the blst field backend the reference links against
+(``/root/reference/crypto/bls/src/impls/blst.rs`` seam).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..bls_oracle.fields import P
+
+jax.config.update("jax_enable_x64", True)
+
+NLIMBS = 25
+LIMB_BITS = 16
+MASK = np.uint64(0xFFFF)
+
+R_MONT = 1 << (NLIMBS * LIMB_BITS)          # 2^400
+R_INV_INT = pow(R_MONT, -1, P)
+N0_INT = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host helper: Python int -> uint64[25] little-endian 16-bit limbs."""
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & 0xFFFF for i in range(NLIMBS)], dtype=np.uint64
+    )
+
+
+def limbs_to_int(a) -> int:
+    """Host helper: limb array (last axis 25, any limb values) -> Python int."""
+    a = np.asarray(a, dtype=np.uint64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(a))
+
+
+def _inflated_2p() -> np.ndarray:
+    """Limbs of 2p rewritten so every limb except the top is >= 2^16 - 1, preserving
+    the value: c_0 stays, c_i (0<i<top) := c_i - 1 + 2^16, top := top - 1."""
+    c = [int(v) for v in int_to_limbs(2 * P)]
+    top = max(i for i, v in enumerate(c) if v)
+    for i in range(1, top + 1):
+        c[i - 1] += (1 << LIMB_BITS) if i <= top else 0
+        c[i] -= 1
+    # re-add: above loop borrowed 1 from each c_i (1..top) into c_{i-1}
+    assert sum(v << (LIMB_BITS * i) for i, v in enumerate(c)) == 2 * P
+    assert all(v >= (1 << LIMB_BITS) - 1 for v in c[:top])
+    return np.array(c, dtype=np.uint64)
+
+
+P_LIMBS = jnp.asarray(int_to_limbs(P))
+SUB2P = jnp.asarray(_inflated_2p())
+N0 = jnp.uint64(N0_INT)
+ONE_M = jnp.asarray(int_to_limbs(R_MONT % P))
+ONE_RAW = jnp.zeros((NLIMBS,), dtype=jnp.uint64).at[0].set(1)
+
+
+def from_int(x: int, mont: bool = True):
+    """Host int -> device limbs (Montgomery form by default); conversion happens
+    host-side with Python bignums."""
+    x %= P
+    return jnp.asarray(int_to_limbs(x * R_MONT % P if mont else x))
+
+
+def from_ints(xs, mont: bool = True):
+    """Batch host conversion: list of ints -> uint64[len(xs), 25]."""
+    return jnp.asarray(
+        np.stack([int_to_limbs(x % P * (R_MONT if mont else 1) % P) for x in xs])
+    )
+
+
+def to_int(a, mont: bool = True) -> int:
+    """Device limbs -> Python int (out of Montgomery form by default). Accepts lazy
+    (non-canonical) values."""
+    v = limbs_to_int(np.asarray(a)) % P
+    return v * R_INV_INT % P if mont else v
+
+
+def to_ints(a, mont: bool = True) -> list:
+    arr = np.asarray(a)
+    return [to_int(arr[i], mont) for i in range(arr.shape[0])]
+
+
+# --------------------------------------------------------------------------------------
+# Lazy ring operations (no normalization — see module docstring for the bounds)
+# --------------------------------------------------------------------------------------
+
+def add(a, b):
+    return a + b
+
+
+def sub(a, b):
+    """a - b + 2p. b must be canonical (16-bit limbs); a may be lazy."""
+    return a + (SUB2P - b)
+
+
+def neg(a):
+    """2p - a. a must be canonical."""
+    return SUB2P - a
+
+
+def double(a):
+    return a + a
+
+
+# --------------------------------------------------------------------------------------
+# Comparisons (canonical operands only)
+# --------------------------------------------------------------------------------------
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond, a, b):
+    """cond ? a : b, with cond of batch shape (no limb axis)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# --------------------------------------------------------------------------------------
+# Montgomery multiplication — the single normalization point
+# --------------------------------------------------------------------------------------
+
+def _carry_propagate(t, out_limbs: int):
+    """lax.scan limb walk: normalize to 16-bit limbs, dropping any final carry
+    (caller guarantees the value fits)."""
+    limbs = jnp.moveaxis(t[..., :out_limbs], -1, 0)
+
+    def step(c, v):
+        v = v + c
+        return v >> np.uint64(LIMB_BITS), v & MASK
+
+    _, outs = jax.lax.scan(step, jnp.zeros_like(limbs[0]), limbs)
+    return jnp.moveaxis(outs, 0, -1)
+
+
+def _sub_limbs(a, b):
+    """a - b with borrow chain (canonical operands). Returns (diff, borrow_out)."""
+    pairs = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(jnp.broadcast_to(b, a.shape), -1, 0))
+
+    def step(borrow, ab):
+        ai, bi = ab
+        v = ai - bi - borrow
+        return (v >> np.uint64(63)).astype(jnp.uint64), v & MASK
+
+    borrow, outs = jax.lax.scan(step, jnp.zeros_like(pairs[0][0]), pairs)
+    return jnp.moveaxis(outs, 0, -1), borrow
+
+
+def _cond_sub_p(a):
+    """Subtract p when a >= p (a < 2p, canonical limbs on entry)."""
+    diff, borrow = _sub_limbs(a, P_LIMBS)
+    return jnp.where((borrow == 1)[..., None], a, diff)
+
+
+def _conv_product(a, b):
+    """Schoolbook 25x25 convolution -> 50 uint64 accumulators. Exact for limbs up
+    to 2^22 (25 * 2^44 < 2^50). Flat shifted-row sum — no update chains."""
+    a, b = jnp.broadcast_arrays(a, b)
+    prod = a[..., :, None] * b[..., None, :]  # [..., 25, 25]
+    batch = prod.shape[:-2]
+    rows = []
+    for i in range(NLIMBS):
+        pad = [(0, 0)] * len(batch) + [(i, NLIMBS - i)]
+        rows.append(jnp.pad(prod[..., i, :], pad))
+    return sum(rows)  # [..., 50]
+
+
+def mont_mul(a, b):
+    """Montgomery product a*b*R^-1 mod p; canonical output. Operand values may be
+    lazy up to 600p with limbs up to 2^22 (see module docstring)."""
+    t = _conv_product(a, b)
+    t = jnp.moveaxis(t, -1, 0)  # [50, ...]
+    p_tail = P_LIMBS[1:].reshape((NLIMBS - 1,) + (1,) * (t.ndim - 1))
+
+    def step(carry, _):
+        buf, c = carry
+        ti = buf[0] + c
+        m = (ti * N0) & MASK
+        buf = buf.at[1:NLIMBS].add(m[None] * p_tail)
+        c = (ti + m * P_LIMBS[0]) >> np.uint64(LIMB_BITS)
+        buf = jnp.concatenate([buf[1:], jnp.zeros_like(buf[:1])], axis=0)
+        return (buf, c), None
+
+    (t, c), _ = jax.lax.scan(step, (t, jnp.zeros_like(t[0])), None, length=NLIMBS)
+    res = jnp.moveaxis(t[:NLIMBS], 0, -1)
+    res = res.at[..., 0].add(c)
+    res = _carry_propagate(res, NLIMBS)  # value < 1.7p at the full operand budget
+    return _cond_sub_p(res)
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def normalize(a):
+    """Lazy -> canonical without changing the Montgomery factor: a * R * R^-1."""
+    return mont_mul(a, jnp.broadcast_to(ONE_M, a.shape))
+
+
+def from_mont(a):
+    """Montgomery -> canonical plain residue: a * 1 * R^-1."""
+    return mont_mul(a, jnp.broadcast_to(ONE_RAW, a.shape))
+
+
+# --------------------------------------------------------------------------------------
+# Fixed-exponent powers (spec constants: inversion, sqrt)
+# --------------------------------------------------------------------------------------
+
+def pow_fixed_scan(a, e: int):
+    """a^e for a fixed host-side exponent via lax.scan (MSB first)."""
+    nbits = max(e.bit_length(), 1)
+    bits = jnp.asarray(
+        [(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=jnp.uint64
+    )
+
+    def step(res, bit):
+        res = mont_sqr(res)
+        res = select(bit == 1, mont_mul(res, a), res)
+        return res, None
+
+    res, _ = jax.lax.scan(step, jnp.broadcast_to(ONE_M, a.shape), bits)
+    return res
+
+
+def inv(a):
+    """Field inverse via Fermat (a^(p-2)); inv(0) = 0 (RFC 9380 inv0 semantics)."""
+    return pow_fixed_scan(a, P - 2)
+
+
+def sqrt_candidate(a):
+    """a^((p+1)/4) — a square root when a is a QR (p = 3 mod 4). Caller checks
+    candidate^2 == a."""
+    return pow_fixed_scan(a, (P + 1) // 4)
+
+
+def sgn0(a):
+    """RFC 9380 sgn0 (parity) of a Montgomery-form element."""
+    return from_mont(a)[..., 0] & jnp.uint64(1)
+
+
+def lex_gt_half(a):
+    """y > (p-1)/2 on a Montgomery-form element — the compressed-point sign bit
+    (ZCash serialization convention used by the reference's pubkey/sig bytes)."""
+    canon = from_mont(a)
+    half = jnp.asarray(int_to_limbs((P - 1) // 2))
+    gt = jnp.zeros(canon.shape[:-1], dtype=bool)
+    decided = jnp.zeros(canon.shape[:-1], dtype=bool)
+    for i in range(NLIMBS - 1, -1, -1):
+        ai, hi = canon[..., i], half[i]
+        gt = jnp.where(~decided & (ai > hi), True, gt)
+        decided = decided | (ai != hi)
+    return gt

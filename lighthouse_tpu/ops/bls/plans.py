@@ -1,0 +1,504 @@
+"""Lane-plan compiler: flattens tower algebra into lincomb -> mont_mul -> lincomb.
+
+A multiplication in Fq2/Fq6/Fq12 is a bilinear map. Karatsuba decomposes it into L
+independent base-field products whose operands are small integer linear combinations
+of the input coefficients, and whose outputs recombine linearly. This module derives
+those linear maps **symbolically at import time** and materializes a tower op as:
+
+    A = lincomb(a)          # [..., L, 25]   (flat adds/subs, no carries)
+    B = lincomb(b)
+    T = fq.mont_mul(A, B)   # ONE stacked Montgomery kernel for all L lanes
+    out = lincomb(T)        # [..., k, 25]
+    out = carry_norm(out)   # one scan: 16-bit limbs, value still lazy (< ~16p)
+
+Why: emitting each base-field multiply as its own XLA op cost ~1s of compile *per
+instance* (an Fq12 multiply has 54), and a kernel launch each at runtime. One wide
+kernel compiles once and feeds the VPU a [54 * batch]-lane workload.
+
+Subtraction never goes negative: a - b is computed as a + (C - b) where C is a
+borrow-inflated multiple of p (every limb of C >= the static per-limb bound of b).
+Static bounds (value in units of p, per-limb magnitude) are tracked through every
+linear combination and asserted against the Montgomery operand budget
+(value < 600p, limbs < 2^22 — see fq.py docstring) at plan-build time.
+
+Element layout (little-endian coefficient order, flat over the tower):
+    fq2  = [..., 2, 25]   (c0, c1)
+    fq6  = [..., 6, 25]   (a0.c0, a0.c1, a1.c0, a1.c1, a2.c0, a2.c1)
+    fq12 = [..., 12, 25]  (b0 fq6 | b1 fq6)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import fq
+from ..bls_oracle.fields import P
+
+# --------------------------------------------------------------------------------------
+# Static bounds for public elements (enforced by carry_norm after every op)
+# --------------------------------------------------------------------------------------
+
+PUB_VALUE_P = 16          # public elements have value < 16 p
+PUB_LIMB = (1 << 16) - 1  # ... and 16-bit limbs (limbs 0..23)
+PUB_TOP_LIMB = 2          # ... limb 24 <= 2 (guaranteed by carry_norm's double fold)
+
+MAX_VALUE_P = 600         # Montgomery operand budget (see fq.py)
+MAX_LIMB = 1 << 22
+
+
+class LC:
+    """Integer linear combination over a basis (dict idx -> coeff)."""
+
+    __slots__ = ("d",)
+
+    def __init__(self, d=None):
+        self.d = {k: v for k, v in (d or {}).items() if v}
+
+    @staticmethod
+    def basis(i):
+        return LC({i: 1})
+
+    def __add__(self, o):
+        d = dict(self.d)
+        for k, v in o.d.items():
+            d[k] = d.get(k, 0) + v
+        return LC(d)
+
+    def __sub__(self, o):
+        d = dict(self.d)
+        for k, v in o.d.items():
+            d[k] = d.get(k, 0) - v
+        return LC(d)
+
+    def __neg__(self):
+        return LC({k: -v for k, v in self.d.items()})
+
+    def scale(self, k: int):
+        return LC({i: v * k for i, v in self.d.items()})
+
+    def __repr__(self):
+        return f"LC({self.d})"
+
+
+# fq2 as [LC, LC]; fq6 as list of 6 LC; fq12 as list of 12 LC.
+
+def v2_add(x, y):
+    return [x[0] + y[0], x[1] + y[1]]
+
+
+def v2_sub(x, y):
+    return [x[0] - y[0], x[1] - y[1]]
+
+
+def v2_nr(x):
+    """Multiply by (u+1)."""
+    return [x[0] - x[1], x[0] + x[1]]
+
+
+def v2_neg(x):
+    return [-x[0], -x[1]]
+
+
+def v2_conj(x):
+    return [x[0], -x[1]]
+
+
+def v6_add(x, y):
+    return [a + b for a, b in zip(x, y)]
+
+
+def v6_sub(x, y):
+    return [a - b for a, b in zip(x, y)]
+
+
+def v6_nr(x):
+    """Multiply by v: (c0, c1, c2) -> (nr(c2), c0, c1)."""
+    return v2_nr(x[4:6]) + x[0:4]
+
+
+def vbasis(n, off=0):
+    return [LC.basis(off + i) for i in range(n)]
+
+
+# --------------------------------------------------------------------------------------
+# Plan builder
+# --------------------------------------------------------------------------------------
+
+class Plan:
+    """a_rows/b_rows: LCs over the A/B input coefficient bases (B may reference a
+    constant pool via indices >= n_b). out_rows: LCs over the lane basis."""
+
+    def __init__(self, n_a: int, n_b: int, consts=None):
+        self.n_a = n_a
+        self.n_b = n_b
+        self.consts = consts or []  # list of Python ints (Montgomery residues)
+        self.a_rows: list[LC] = []
+        self.b_rows: list[LC] = []
+        self.out_rows: list[LC] = []
+
+    def lane(self, va: LC, vb: LC) -> LC:
+        self.a_rows.append(va)
+        self.b_rows.append(vb)
+        return LC.basis(len(self.a_rows) - 1)
+
+    @staticmethod
+    def inp(i: int) -> LC:
+        """Reference input coefficient i inside an out_row (input pass-through).
+        Encoded as negative basis index; execute() remaps onto [lanes | a]."""
+        return LC.basis(-(i + 1))
+
+    def mul2(self, x, y):
+        """3-lane Karatsuba Fq2 product; returns fq2 over lanes."""
+        l0 = self.lane(x[0], y[0])
+        l1 = self.lane(x[1], y[1])
+        l2 = self.lane(x[0] + x[1], y[0] + y[1])
+        return [l0 - l1, l2 - l0 - l1]
+
+    def sqr2(self, x):
+        """2-lane Fq2 square (same operand on both sides)."""
+        l0 = self.lane(x[0] + x[1], x[0] - x[1])
+        l1 = self.lane(x[0], x[1])
+        return [l0, l1 + l1]
+
+    def mul6(self, x, y):
+        x0, x1, x2 = x[0:2], x[2:4], x[4:6]
+        y0, y1, y2 = y[0:2], y[2:4], y[4:6]
+        t0 = self.mul2(x0, y0)
+        t1 = self.mul2(x1, y1)
+        t2 = self.mul2(x2, y2)
+        t12 = self.mul2(v2_add(x1, x2), v2_add(y1, y2))
+        t01 = self.mul2(v2_add(x0, x1), v2_add(y0, y1))
+        t02 = self.mul2(v2_add(x0, x2), v2_add(y0, y2))
+        c0 = v2_add(v2_nr(v2_sub(v2_sub(t12, t1), t2)), t0)
+        c1 = v2_add(v2_sub(v2_sub(t01, t0), t1), v2_nr(t2))
+        c2 = v2_add(v2_sub(v2_sub(t02, t0), t2), t1)
+        return c0 + c1 + c2
+
+    def mul12(self, x, y):
+        x0, x1 = x[0:6], x[6:12]
+        y0, y1 = y[0:6], y[6:12]
+        t0 = self.mul6(x0, y0)
+        t1 = self.mul6(x1, y1)
+        t2 = self.mul6(v6_add(x0, x1), v6_add(y0, y1))
+        c0 = v6_add(t0, v6_nr(t1))
+        c1 = v6_sub(v6_sub(t2, t0), t1)
+        return c0 + c1
+
+
+# --------------------------------------------------------------------------------------
+# Borrow-inflated subtraction constants
+# --------------------------------------------------------------------------------------
+
+_SUBC_CACHE: dict[tuple[int, int], tuple[np.ndarray, int]] = {}
+
+
+def _subc(limb_cover: int, top_cover: int):
+    """A constant C = K*p whose borrow-inflated limb representation has every limb
+    0..23 >= limb_cover and limb 24 >= top_cover (so C - x never underflows per
+    limb for x within those bounds). Returns (limbs uint64[25], K)."""
+    key = (limb_cover, top_cover)
+    if key in _SUBC_CACHE:
+        return _SUBC_CACHE[key]
+    # borrow m from each limb into the one below: limbs 1..23 gain m*2^16 - m
+    m = max(-(-limb_cover // ((1 << 16) - 1)), 1)
+    K = 1
+    while True:
+        if (K * P).bit_length() > 400:
+            raise AssertionError("subc constant exceeds 25 limbs")
+        c = [int(v) for v in fq.int_to_limbs(K * P)]
+        for i in range(1, 25):
+            c[i - 1] += m << 16
+            c[i] -= m
+        if (
+            all(v >= 0 for v in c)
+            and all(c[i] >= limb_cover for i in range(24))
+            and c[24] >= top_cover
+        ):
+            assert sum(v << (16 * i) for i, v in enumerate(c)) == K * P
+            arr = np.array(c, dtype=np.uint64)
+            _SUBC_CACHE[key] = (arr, K)
+            return arr, K
+        K += 1
+
+
+# --------------------------------------------------------------------------------------
+# Materializer
+# --------------------------------------------------------------------------------------
+
+class _Bound:
+    """Static (value_p, limb, top_limb) bound triple with exact algebra: bounds
+    compose through lazy adds/subs so every borrow-inflated constant provably
+    dominates its subtrahend limb-by-limb."""
+
+    __slots__ = ("value_p", "limb", "top")
+
+    def __init__(self, value_p, limb, top):
+        self.value_p = value_p
+        self.limb = limb
+        self.top = top
+
+    def __add__(self, o: "_Bound") -> "_Bound":
+        return _Bound(self.value_p + o.value_p, self.limb + o.limb, self.top + o.top)
+
+    def __or__(self, o: "_Bound") -> "_Bound":
+        """Elementwise max (either-of)."""
+        return _Bound(
+            max(self.value_p, o.value_p), max(self.limb, o.limb), max(self.top, o.top)
+        )
+
+    def scaled(self, k: int) -> "_Bound":
+        return _Bound(self.value_p * k, self.limb * k, self.top * k)
+
+
+def sub_bound(minuend: "_Bound", subtrahend: "_Bound") -> "_Bound":
+    """Bound of minuend + (C - subtrahend) for the _subc constant that covers
+    the subtrahend."""
+    sc, K = _subc(subtrahend.limb, subtrahend.top)
+    return _Bound(
+        minuend.value_p + K,
+        minuend.limb + int(max(sc[:24])),
+        minuend.top + int(sc[24]),
+    )
+
+
+PUB_BOUND = _Bound(PUB_VALUE_P, PUB_LIMB, PUB_TOP_LIMB)
+CANON_BOUND = _Bound(1, PUB_LIMB, 0)
+
+
+def lincomb(rows: list[LC], x, in_bound: _Bound, name: str = "", bound_for=None) -> tuple:
+    """Materialize rows of linear combinations of x[..., n, 25]. Returns
+    (stacked [..., L, 25], out_bound). ``bound_for(idx)`` optionally gives a
+    per-index input bound (default: in_bound for all indices)."""
+    bound_for = bound_for or (lambda _i: in_bound)
+    outs = []
+    worst = _Bound(0, 0, 0)
+    for lc in rows:
+        pos = None
+        neg = None
+        value_p = limb = top = 0
+        n_limb = n_top = 0  # accumulated per-limb bounds of the negative part
+        for idx, c in sorted(lc.d.items()):
+            b = bound_for(idx)
+            mag = abs(c)
+            term = x[..., idx, :]
+            if mag != 1:
+                term = term * np.uint64(mag)
+            if c > 0:
+                pos = term if pos is None else pos + term
+                value_p += mag * b.value_p
+                limb += mag * b.limb
+                top += mag * b.top
+            else:
+                neg = term if neg is None else neg + term
+                n_limb += mag * b.limb
+                n_top += mag * b.top
+        if neg is not None:
+            subc, K = _subc(n_limb, n_top)
+            base = jnp.asarray(subc) - neg
+            pos = base if pos is None else pos + base
+            value_p += K
+            limb += int(subc[0])
+            top += int(subc[24])
+        elif pos is None:
+            pos = jnp.zeros_like(x[..., 0, :])
+        assert value_p < MAX_VALUE_P, f"{name}: value bound {value_p}p exceeds budget"
+        assert limb < MAX_LIMB, f"{name}: limb bound {limb} exceeds 2^22"
+        outs.append(pos)
+        worst.value_p = max(worst.value_p, value_p)
+        worst.limb = max(worst.limb, limb)
+        worst.top = max(worst.top, top)
+    return jnp.stack(outs, axis=-2), worst
+
+
+# Raw (non-domain) limbs of 2^384 mod p: folds limb-24 excess back below 2^384.
+# Works on Montgomery-coded values too — the fold is a congruence on the coded value.
+_RT384 = jnp.asarray(fq.int_to_limbs((1 << 384) % P))
+
+
+def carry_norm(x):
+    """Restore public bounds: normalize limbs, then fold the 2^384-and-up excess
+    through (2^384 mod p), twice. Bound walk for input value V*p (V < 600):
+    after fold 1 the value is < 2^384 + top*(2^384 mod p) with top <= V/9.33+1,
+    i.e. < (9.34 + 0.33*(V*0.108+1))p < 62p; its top limb is <= 9, so fold 2
+    lands < (9.34 + 0.33*10)p < 13p with limb24 <= 2. Hence the public contract
+    PUB_VALUE_P=16 / PUB_TOP_LIMB=2 holds for any input under the budget."""
+    for _ in range(2):
+        x = fq._carry_propagate(x, fq.NLIMBS)
+        top = x[..., 24]
+        low = x.at[..., 24].set(0)
+        x = low + top[..., None] * _RT384
+    return fq._carry_propagate(x, fq.NLIMBS)
+
+
+def execute(plan: Plan, a, b, in_bound_a=PUB_BOUND, in_bound_b=PUB_BOUND, name=""):
+    """Run a plan: returns [..., n_out, 25] public-bounded output."""
+    A, _ = lincomb(plan.a_rows, a, in_bound_a, name + ".A")
+    if plan.consts:
+        cpool = jnp.asarray(
+            np.stack([fq.int_to_limbs(c) for c in plan.consts])
+        )
+        cpool = jnp.broadcast_to(cpool, b.shape[:-2] + cpool.shape)
+        b = jnp.concatenate([b, cpool], axis=-2)
+    B, _ = lincomb(plan.b_rows, b, in_bound_b, name + ".B")
+    T = fq.mont_mul(A, B)
+    L = len(plan.a_rows)
+    if any(i < 0 for lc in plan.out_rows for i in lc.d):
+        # out rows reference inputs (pass-through): append `a` after the lanes
+        T = jnp.concatenate([T, a], axis=-2)
+        out_rows = [
+            LC({(i if i >= 0 else L - 1 - i): c for i, c in lc.d.items()})
+            for lc in plan.out_rows
+        ]
+        out, _ = lincomb(
+            out_rows, T, CANON_BOUND, name + ".out",
+            bound_for=lambda i: CANON_BOUND if i < L else in_bound_a,
+        )
+    else:
+        out, _ = lincomb(plan.out_rows, T, CANON_BOUND, name + ".out")
+    return carry_norm(out)
+
+
+# --------------------------------------------------------------------------------------
+# Prebuilt plans
+# --------------------------------------------------------------------------------------
+
+def _build_mul(k: int) -> Plan:
+    p = Plan(k, k)
+    x, y = vbasis(k), vbasis(k)
+    if k == 2:
+        p.out_rows = p.mul2(x, y)
+    elif k == 6:
+        p.out_rows = p.mul6(x, y)
+    elif k == 12:
+        p.out_rows = p.mul12(x, y)
+    return p
+
+
+MUL2 = _build_mul(2)
+MUL6 = _build_mul(6)
+MUL12 = _build_mul(12)
+
+
+def _build_sqr2() -> Plan:
+    p = Plan(2, 2)
+    x = vbasis(2)
+    p.out_rows = p.sqr2(x)
+    # sqr plans put the same element on both sides; b_rows reference the A basis
+    return p
+
+
+SQR2 = _build_sqr2()
+
+
+def _build_sqr12() -> Plan:
+    """fq12 square via 2 fq6 products: t = a0*a1; s = (a0+a1)(a0 + nr(a1));
+    c0 = s - t - nr(t); c1 = 2t."""
+    p = Plan(12, 12)
+    x = vbasis(12)
+    a0, a1 = x[0:6], x[6:12]
+    t = p.mul6(a0, a1)
+    s = p.mul6(v6_add(a0, a1), v6_add(a0, v6_nr(a1)))
+    c0 = v6_sub(v6_sub(s, t), v6_nr(t))
+    c1 = v6_add(t, t)
+    p.out_rows = c0 + c1
+    return p
+
+
+SQR12 = _build_sqr12()
+
+
+def _build_cyc_sqr() -> Plan:
+    """Granger-Scott cyclotomic square: 9 Fq2 squares (18 lanes) + linear glue."""
+    p = Plan(12, 12)
+    x = vbasis(12)
+    # coefficient layout: fq12 = (c0=(z0,z4,z3), c1=(z2,z1,z5)) in fq2 slots
+    z0, z4, z3 = x[0:2], x[2:4], x[4:6]
+    z2, z1, z5 = x[6:8], x[8:10], x[10:12]
+    # out-row references to the inputs use pass-through indices
+    iz0, iz4, iz3 = [p.inp(0), p.inp(1)], [p.inp(2), p.inp(3)], [p.inp(4), p.inp(5)]
+    iz2, iz1, iz5 = [p.inp(6), p.inp(7)], [p.inp(8), p.inp(9)], [p.inp(10), p.inp(11)]
+    sq = {}
+    for nm, (u, v) in {"a": (z0, z1), "b": (z2, z3), "c": (z4, z5)}.items():
+        sq[nm + "0"] = p.sqr2(u)
+        sq[nm + "1"] = p.sqr2(v)
+        sq[nm + "x"] = p.sqr2(v2_add(u, v))
+
+    def fq4(nm):
+        t0, t1, txy = sq[nm + "0"], sq[nm + "1"], sq[nm + "x"]
+        return (
+            v2_add(v2_nr(t1), t0),
+            v2_sub(v2_sub(txy, t0), t1),
+        )
+
+    t0, t1 = fq4("a")
+    t2, t3 = fq4("b")
+    t4, t5 = fq4("c")
+
+    def tri_sub(t, z):
+        d = v2_sub(t, z)
+        return v2_add(v2_add(d, d), t)
+
+    def tri_add(t, z):
+        s = v2_add(t, z)
+        return v2_add(v2_add(s, s), t)
+
+    z0n = tri_sub(t0, iz0)
+    z1n = tri_add(t1, iz1)
+    z2n = tri_add(v2_nr(t5), iz2)
+    z3n = tri_sub(t4, iz3)
+    z4n = tri_sub(t2, iz4)
+    z5n = tri_add(t3, iz5)
+    p.out_rows = z0n + z4n + z3n + z2n + z1n + z5n
+    return p
+
+
+CYC_SQR = _build_cyc_sqr()
+
+
+def _mont(c: int) -> int:
+    return c * fq.R_MONT % P
+
+
+def _build_frob12() -> Plan:
+    """Power-1 Frobenius on fq12. Lanes multiply conjugated coefficients by the
+    Frobenius constants (constant pool on the B side); z0-conj passes through a
+    multiply by one to keep everything in one kernel."""
+    from ..bls_oracle import fields as _of
+
+    g6c1, g6c2, g12 = _of._FROB_FQ6_C1_1, _of._FROB_FQ6_C2_1, _of._FROB_FQ12_C1_1
+    consts = []
+
+    def cidx(val: int) -> LC:
+        v = _mont(val)
+        if v not in consts:
+            consts.append(v)
+        return LC.basis(12 + consts.index(v))
+
+    p = Plan(12, 12)
+    x = vbasis(12)
+
+    def fq6_frob(sl, extra: "_of.Fq2 | None"):
+        """Frobenius of an fq6 slice, optionally followed by * extra (fq12 gamma)."""
+        cs = [v2_conj(sl[0:2]), v2_conj(sl[2:4]), v2_conj(sl[4:6])]
+        gammas = [_of.Fq2(1, 0), g6c1, g6c2]
+        out = []
+        for coef, gam in zip(cs, gammas):
+            g = gam * extra if extra is not None else gam
+            # (c0 + c1 u) * (g0 + g1 u) with g constant:
+            g0, g1 = cidx(g.c0), cidx(g.c1)
+            l00 = p.lane(coef[0], g0)
+            l11 = p.lane(coef[1], g1)
+            lx = p.lane(coef[0] + coef[1], g0 + g1)
+            out += [l00 - l11, lx - l00 - l11]
+        return out
+
+    c0 = fq6_frob(x[0:6], None)
+    c1 = fq6_frob(x[6:12], g12)
+    p.out_rows = c0 + c1
+    p.consts = consts
+    return p
+
+
+FROB12 = _build_frob12()

@@ -1,0 +1,356 @@
+"""Fq2 / Fq6 / Fq12 tower arithmetic as JAX kernels (plan-compiled).
+
+Flat element layout (see plans.py): fq2 = [..., 2, 25], fq6 = [..., 6, 25],
+fq12 = [..., 12, 25] of uint64 16-bit limbs, Montgomery form, "public" bounds
+(16-bit limbs, value < 16p — reduced mod p only at comparisons/serialization).
+
+Every multiplication-bearing op runs as lincomb -> one stacked mont_mul -> lincomb
+via a prebuilt plan. Additions are lazy (no carries). Fixed-exponent walks use
+lax.scan. Tower layout matches the oracle (``ops.bls_oracle.fields``): Fq2 =
+Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3-(u+1)), Fq12 = Fq6[w]/(w^2-v).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import fq
+from . import plans
+from .plans import PUB_BOUND, _Bound
+from ..bls_oracle import fields as _of
+
+# --------------------------------------------------------------------------------------
+# Generic helpers on flat elements
+# --------------------------------------------------------------------------------------
+
+def t_add(a, b):
+    """Lazy add (any width)."""
+    return a + b
+
+
+def t_sub(a, b, b_bound: _Bound = PUB_BOUND):
+    """Lazy a - b via a borrow-inflated constant that limb-wise dominates b's
+    static bound. Callers with non-public b must pass its exact bound."""
+    sc, _ = plans._subc(b_bound.limb, b_bound.top)
+    return a + (jnp.asarray(sc) - b)
+
+
+def t_neg(b, b_bound: _Bound = PUB_BOUND):
+    sc, _ = plans._subc(b_bound.limb, b_bound.top)
+    return jnp.asarray(sc) - b
+
+
+def nr_bound(in_b: _Bound = PUB_BOUND) -> _Bound:
+    """Static bound of fq2_mul_by_nonresidue output given its input bound:
+    c0' = c0 + (C - c1) and c1' = c0 + c1."""
+    return plans.sub_bound(in_b, in_b) | in_b.scaled(2)
+
+
+def t_select(cond, a, b):
+    """cond ? a : b with cond of batch shape (no component/limb axes)."""
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def t_canon(a):
+    """Fully reduce each coefficient mod p (for comparisons / serialization):
+    one stacked Montgomery multiply by R."""
+    return fq.mont_mul(a, jnp.broadcast_to(fq.ONE_M, a.shape))
+
+
+def t_eq(a, b):
+    """Equality on *canonicalized* elements."""
+    return jnp.all(t_canon(a) == t_canon(b), axis=(-2, -1))
+
+
+def t_is_zero(a):
+    return jnp.all(t_canon(a) == 0, axis=(-2, -1))
+
+
+def zero(k: int, shape=()):
+    return jnp.zeros(shape + (k, fq.NLIMBS), dtype=jnp.uint64)
+
+
+def one(k: int, shape=()):
+    z = np.zeros((k, fq.NLIMBS), dtype=np.uint64)
+    z[0] = np.asarray(fq.int_to_limbs(fq.R_MONT % _of.P))
+    return jnp.broadcast_to(jnp.asarray(z), shape + (k, fq.NLIMBS))
+
+
+# host <-> device ----------------------------------------------------------------------
+
+def from_ints(coeffs, mont: bool = True):
+    """list of k ints -> [k, 25]."""
+    return jnp.asarray(
+        np.stack(
+            [fq.int_to_limbs(c % _of.P * (fq.R_MONT if mont else 1) % _of.P) for c in coeffs]
+        )
+    )
+
+
+def to_ints(a, mont: bool = True):
+    arr = np.asarray(a)
+    assert arr.ndim == 2
+    return [fq.to_int(arr[i], mont) for i in range(arr.shape[0])]
+
+
+def fq2_from_oracle(x: _of.Fq2):
+    return from_ints([x.c0, x.c1])
+
+
+def fq2_to_oracle(a) -> _of.Fq2:
+    a = np.asarray(t_canon(a))
+    return _of.Fq2(*to_ints(a))
+
+
+def fq6_from_oracle(x: _of.Fq6):
+    return from_ints([x.c0.c0, x.c0.c1, x.c1.c0, x.c1.c1, x.c2.c0, x.c2.c1])
+
+
+def fq12_from_oracle(x: _of.Fq12):
+    return from_ints(
+        [
+            x.c0.c0.c0, x.c0.c0.c1, x.c0.c1.c0, x.c0.c1.c1, x.c0.c2.c0, x.c0.c2.c1,
+            x.c1.c0.c0, x.c1.c0.c1, x.c1.c1.c0, x.c1.c1.c1, x.c1.c2.c0, x.c1.c2.c1,
+        ]
+    )
+
+
+def fq12_to_oracle(a) -> _of.Fq12:
+    v = to_ints(np.asarray(t_canon(a)))
+    f2 = lambda i: _of.Fq2(v[i], v[i + 1])
+    return _of.Fq12(
+        _of.Fq6(f2(0), f2(2), f2(4)),
+        _of.Fq6(f2(6), f2(8), f2(10)),
+    )
+
+
+def fq6_to_oracle(a) -> _of.Fq6:
+    v = to_ints(np.asarray(t_canon(a)))
+    f2 = lambda i: _of.Fq2(v[i], v[i + 1])
+    return _of.Fq6(f2(0), f2(2), f2(4))
+
+
+# --------------------------------------------------------------------------------------
+# Fq2
+# --------------------------------------------------------------------------------------
+
+def fq2_mul(a, b, in_bound=PUB_BOUND):
+    return plans.execute(plans.MUL2, a, b, in_bound, in_bound, "fq2_mul")
+
+
+def fq2_sqr(a, in_bound=PUB_BOUND):
+    return plans.execute(plans.SQR2, a, a, in_bound, in_bound, "fq2_sqr")
+
+
+def fq2_add(a, b):
+    return a + b
+
+
+def fq2_sub(a, b, b_bound: _Bound = PUB_BOUND):
+    return t_sub(a, b, b_bound)
+
+
+def fq2_neg(a, b_bound: _Bound = PUB_BOUND):
+    return t_neg(a, b_bound)
+
+
+def fq2_conj(a, b_bound: _Bound = PUB_BOUND):
+    return jnp.stack([a[..., 0, :], t_neg(a[..., 1, :], b_bound)], axis=-2)
+
+
+def fq2_mul_by_nonresidue(a, b_bound: _Bound = PUB_BOUND):
+    """(u+1) * a = (c0 - c1, c0 + c1). Output bound: nr_bound(b_bound)."""
+    c0, c1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([t_sub(c0, c1, b_bound), c0 + c1], axis=-2)
+
+
+def fq2_inv(a):
+    """1/(c0 + c1 u) = (c0 - c1 u) / (c0^2 + c1^2); inv0 semantics for zero.
+    Accepts public-bounded input."""
+    a = t_canon(a)
+    c0, c1 = a[..., 0, :], a[..., 1, :]
+    n = fq.mont_sqr(c0) + fq.mont_sqr(c1)
+    t = fq.inv(n)  # canonical
+    r = fq.mont_mul(
+        jnp.stack([c0, fq.neg(c1)], axis=-2),
+        jnp.broadcast_to(t[..., None, :], a.shape),
+    )
+    return r
+
+
+def fq2_pow_fixed(a, e: int):
+    nbits = max(e.bit_length(), 1)
+    bits = jnp.asarray(
+        [(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=jnp.uint64
+    )
+    o = one(2, a.shape[:-2])
+
+    def step(res, bit):
+        res = fq2_sqr(res)
+        res = t_select(bit == 1, fq2_mul(res, a), res)
+        return res, None
+
+    res, _ = jax.lax.scan(step, o, bits)
+    return res
+
+
+def fq2_sgn0(a):
+    c = fq.from_mont(t_canon(a))
+    c0, c1 = c[..., 0, :], c[..., 1, :]
+    s0 = c0[..., 0] & jnp.uint64(1)
+    z0 = fq.is_zero(c0)
+    s1 = c1[..., 0] & jnp.uint64(1)
+    return s0 | (z0.astype(jnp.uint64) & s1)
+
+
+def fq2_sqrt(a):
+    """Square root in Fq2 (p = 3 mod 4). Returns (root, is_square)."""
+    a1 = fq2_pow_fixed(a, (_of.P - 3) // 4)
+    x0 = fq2_mul(a1, a)
+    alpha = fq2_mul(a1, x0)
+    minus_one = from_ints([_of.P - 1, 0])
+    is_minus_one = t_eq(alpha, jnp.broadcast_to(minus_one, alpha.shape))
+    x0c = t_canon(x0)
+    cand_a = jnp.stack(
+        [fq.neg(x0c[..., 1, :]), x0c[..., 0, :]], axis=-2
+    )  # u * x0
+    b = fq2_pow_fixed(fq2_add(alpha, one(2, alpha.shape[:-2])), (_of.P - 1) // 2)
+    cand_b = fq2_mul(b, x0)
+    root = t_select(is_minus_one, cand_a, cand_b)
+    ok = t_eq(fq2_sqr(root), a)
+    return root, ok
+
+
+# Stacked many-muls: k independent fq2 products in one kernel (for curve formulas).
+_MUL2_MANY: dict[int, plans.Plan] = {}
+_SQR2_MANY: dict[int, plans.Plan] = {}
+
+
+def _mul2_many_plan(k: int) -> plans.Plan:
+    if k not in _MUL2_MANY:
+        p = plans.Plan(2 * k, 2 * k)
+        out = []
+        for i in range(k):
+            x = [plans.LC.basis(2 * i), plans.LC.basis(2 * i + 1)]
+            out += p.mul2(x, x)  # a_rows index the A input, b_rows the B input
+        p.out_rows = out
+        _MUL2_MANY[k] = p
+    return _MUL2_MANY[k]
+
+
+def fq2_mul_many(pairs, in_bound=PUB_BOUND):
+    """pairs: list of (a, b) fq2 arrays (same batch shape). One kernel for all.
+    Returns list of fq2 products."""
+    k = len(pairs)
+    plan = _mul2_many_plan(k)
+    A = jnp.concatenate([p[0] for p in pairs], axis=-2)  # [..., 2k, 25]
+    B = jnp.concatenate([p[1] for p in pairs], axis=-2)
+    out = plans.execute(plan, A, B, in_bound, in_bound, f"fq2_mul_many{k}")
+    return [out[..., 2 * i : 2 * i + 2, :] for i in range(k)]
+
+
+# --------------------------------------------------------------------------------------
+# Fq6 (used by fq12 inversion)
+# --------------------------------------------------------------------------------------
+
+def fq6_mul(a, b, in_bound=PUB_BOUND):
+    return plans.execute(plans.MUL6, a, b, in_bound, in_bound, "fq6_mul")
+
+
+def fq6_nr(a):
+    """v * a: rotate fq2 slots and apply (u+1) to the last."""
+    c2 = fq2_mul_by_nonresidue(a[..., 4:6, :])
+    return jnp.concatenate([c2, a[..., 0:4, :]], axis=-2)
+
+
+def fq6_neg(a, b_bound: _Bound = PUB_BOUND):
+    return t_neg(a, b_bound)
+
+
+def fq6_inv(a):
+    PUB = PUB_BOUND
+    a0, a1, a2 = a[..., 0:2, :], a[..., 2:4, :], a[..., 4:6, :]
+    s0, s2, s1, m12, m01, m02 = fq2_mul_many(
+        [(a0, a0), (a2, a2), (a1, a1), (a1, a2), (a0, a1), (a0, a2)]
+    )
+    # exact static bounds threaded through every lazy sub
+    nrb = nr_bound(PUB)
+    t0 = t_sub(s0, fq2_mul_by_nonresidue(m12), nrb)
+    t0_b = plans.sub_bound(PUB, nrb)
+    t1 = fq2_sub(fq2_mul_by_nonresidue(s2), m01)
+    t1_b = plans.sub_bound(nrb, PUB)
+    t2 = fq2_sub(s1, m02)
+    t2_b = plans.sub_bound(PUB, PUB)
+    lazy = t0_b | t1_b | t2_b
+    m0, m1, m2 = fq2_mul_many([(a0, t0), (a2, t1), (a1, t2)], in_bound=lazy)
+    denom = fq2_add(m0, fq2_mul_by_nonresidue(fq2_add(m1, m2), PUB.scaled(2)))
+    dinv = fq2_inv(t_canon(denom))
+    r0, r1, r2 = fq2_mul_many(
+        [(t0, dinv), (t1, dinv), (t2, dinv)], in_bound=lazy
+    )
+    return jnp.concatenate([r0, r1, r2], axis=-2)
+
+
+# --------------------------------------------------------------------------------------
+# Fq12
+# --------------------------------------------------------------------------------------
+
+def fq12_mul(a, b, in_bound=PUB_BOUND):
+    return plans.execute(plans.MUL12, a, b, in_bound, in_bound, "fq12_mul")
+
+
+def fq12_sqr(a, in_bound=PUB_BOUND):
+    return plans.execute(plans.SQR12, a, a, in_bound, in_bound, "fq12_sqr")
+
+
+def fq12_conj(a):
+    """p^6 Frobenius: negate the w coefficient (last 6 fq coefficients)."""
+    return jnp.concatenate([a[..., 0:6, :], fq6_neg(a[..., 6:12, :])], axis=-2)
+
+
+def fq12_inv(a):
+    a0, a1 = a[..., 0:6, :], a[..., 6:12, :]
+    s0 = fq6_mul(a0, a0)
+    s1 = fq6_mul(a1, a1)
+    t = fq6_inv(t_canon(t_sub(s0, fq6_nr(s1), nr_bound(PUB_BOUND))))
+    c0 = fq6_mul(a0, t)
+    c1 = fq6_neg(fq6_mul(a1, t))
+    return jnp.concatenate([c0, c1], axis=-2)
+
+
+def fq12_frobenius1(a):
+    return plans.execute(plans.FROB12, a, a, PUB_BOUND, PUB_BOUND, "frob12")
+
+
+def fq12_frobenius(a, power: int):
+    for _ in range(power % 12):
+        a = fq12_frobenius1(a)
+    return a
+
+
+def fq12_cyclotomic_sqr(a, in_bound=PUB_BOUND):
+    return plans.execute(plans.CYC_SQR, a, a, in_bound, in_bound, "cyc_sqr")
+
+
+def fq12_cyclotomic_exp_abs_x(a):
+    """a^|x| (|x| = 0xd201000000010000) via scan of cyclotomic squarings."""
+    x_abs = -_of.BLS_X
+    nbits = x_abs.bit_length()
+    bits = jnp.asarray(
+        [(x_abs >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=jnp.uint64
+    )
+
+    def step(res, bit):
+        res = fq12_cyclotomic_sqr(res)
+        res = t_select(bit == 1, fq12_mul(res, a), res)
+        return res, None
+
+    res, _ = jax.lax.scan(step, a, bits[1:])  # MSB consumed by starting at a
+    return res
+
+
+def fq12_is_one(a):
+    return t_eq(a, one(12, a.shape[:-2]))

@@ -21,9 +21,7 @@ from __future__ import annotations
 import hashlib
 
 from .fields import P, BLS_X, Fq2
-from .curves import (
-    B2, OPS_FQ2, _to_jac, _to_affine, _jac_add, g2_add, g2_mul, g2_is_on_curve,
-)
+from .curves import g2_add, g2_mul
 
 # --- expand_message_xmd --------------------------------------------------------------
 

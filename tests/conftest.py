@@ -1,15 +1,16 @@
 """Test configuration: force a virtual 8-device CPU platform.
 
 Multi-chip hardware is not available in CI; sharding tests run on a virtual CPU mesh
-(``XLA_FLAGS=--xla_force_host_platform_device_count=8``), mirroring how the driver
-dry-runs the multi-chip path. Must run before jax is imported anywhere.
+(``--xla_force_host_platform_device_count=8``), mirroring how the driver dry-runs the
+multi-chip path. The recipe lives in devcpu.py (shared with dev scripts): the platform
+override must use jax.config, not just the env var — the environment's sitecustomize
+registers the axon TPU plugin and force-selects it, and its PJRT client init would
+otherwise run (and block on the tunnel) even for CPU-only tests.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import devcpu  # noqa: F401  (side effect: CPU platform + 8 virtual devices)
