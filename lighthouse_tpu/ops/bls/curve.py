@@ -1,0 +1,290 @@
+"""Branchless projective curve kernels for BLS12-381 G1/G2 (plan-compiled).
+
+Points are homogeneous projective (X : Y : Z) on y^2 z = x^3 + b z^3 with the
+point at infinity (0 : 1 : 0), stored as one flat array ``[..., 3k, 25]`` of
+Montgomery-form 16-bit limbs (k = 1 for G1/Fq, k = 2 for G2/Fq2) — X | Y | Z
+concatenated on the coefficient axis.
+
+Group ops use the Renes–Costello–Batina *complete* addition formulas for a = 0
+curves (eprint 2015/1060, algorithms 7 and 9): no branches, no special cases —
+infinity, doubling, and inverse inputs all flow through the same arithmetic.
+That is exactly what a vmapped/jitted TPU kernel wants, and it is the design
+departure from the reference's blst backend (``/root/reference/crypto/bls/src/
+impls/blst.rs``), which branches per point on the CPU.
+
+Each formula is *depth-2 in multiplications*, so a point add/double compiles to
+exactly two stacked Montgomery kernels (plans.execute): the 6 (add) / 4 (double)
+field products of each level run as one wide ``mont_mul`` over all Karatsuba
+lanes, with every linear step folded into the surrounding lincombs. Multiplying
+by the curve constant b3 = 3b (12 for G1, 12(u+1) for G2) is linear and costs
+no lanes. Static value/limb bounds are tracked and asserted by the plan
+machinery at build time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import fq
+from . import plans
+from . import tower
+from .plans import LC, PUB_BOUND
+
+# --------------------------------------------------------------------------------------
+# Coefficient-vector helpers (k = 1: [LC]; k = 2: [LC, LC] little-endian Fq2)
+# --------------------------------------------------------------------------------------
+
+
+def _vec(k: int, off: int):
+    return [LC.basis(off + i) for i in range(k)]
+
+
+def _vadd(x, y):
+    return [a + b for a, b in zip(x, y)]
+
+
+def _vsub(x, y):
+    return [a - b for a, b in zip(x, y)]
+
+
+def _vscale(x, c: int):
+    return [a.scale(c) for a in x]
+
+
+def _b3(k: int, v):
+    """Multiply by 3b: G1 b = 4 -> scale 12; G2 b = 4(u+1) -> 12 * (u+1)."""
+    if k == 1:
+        return _vscale(v, 12)
+    return _vscale(plans.v2_nr(v), 12)
+
+
+def _kmul(p: plans.Plan, k: int, x, y):
+    return [p.lane(x[0], y[0])] if k == 1 else p.mul2(x, y)
+
+
+def _ksqr(p: plans.Plan, k: int, x):
+    return [p.lane(x[0], x[0])] if k == 1 else p.sqr2(x)
+
+
+# --------------------------------------------------------------------------------------
+# Plan builders (cached per k)
+# --------------------------------------------------------------------------------------
+
+_ADD_PLANS: dict[int, tuple] = {}
+_DBL_PLANS: dict[int, tuple] = {}
+
+
+def _add_plans(k: int):
+    """RCB15 algorithm 7 as two plans.
+
+    Level 1 emits [m_a, m_b, m_c, t0, t1, t2n] where
+      m_a = X1Y2 + X2Y1,  m_b = Y1Z2 + Y2Z1,  m_c = X1Z2 + X2Z1,
+      t0 = 3 X1X2,  t1 = Y1Y2,  t2n = b3 Z1Z2.
+    Level 2 computes (with y3 = b3 m_c, z3p = t1 + t2n, t1p = t1 - t2n):
+      X3 = m_a t1p - m_b y3,  Y3 = t1p z3p + y3 t0,  Z3 = z3p m_b + t0 m_a.
+    """
+    if k in _ADD_PLANS:
+        return _ADD_PLANS[k]
+    p1 = plans.Plan(3 * k, 3 * k)
+    x1, y1, z1 = _vec(k, 0), _vec(k, k), _vec(k, 2 * k)
+    x2, y2, z2 = _vec(k, 0), _vec(k, k), _vec(k, 2 * k)  # B side, same indices
+    pxx = _kmul(p1, k, x1, x2)
+    pyy = _kmul(p1, k, y1, y2)
+    pzz = _kmul(p1, k, z1, z2)
+    pxy = _kmul(p1, k, _vadd(x1, y1), _vadd(x2, y2))
+    pyz = _kmul(p1, k, _vadd(y1, z1), _vadd(y2, z2))
+    pxz = _kmul(p1, k, _vadd(x1, z1), _vadd(x2, z2))
+    m_a = _vsub(_vsub(pxy, pxx), pyy)
+    m_b = _vsub(_vsub(pyz, pyy), pzz)
+    m_c = _vsub(_vsub(pxz, pxx), pzz)
+    t0 = _vscale(pxx, 3)
+    t1 = pyy
+    t2n = _b3(k, pzz)
+    p1.out_rows = m_a + m_b + m_c + t0 + t1 + t2n
+
+    p2 = plans.Plan(6 * k, 6 * k)
+    ma, mb, mc, t0v, t1v, t2v = (_vec(k, i * k) for i in range(6))
+    y3 = _b3(k, mc)
+    z3p = _vadd(t1v, t2v)
+    t1p = _vsub(t1v, t2v)
+    q1 = _kmul(p2, k, mb, y3)
+    q2 = _kmul(p2, k, ma, t1p)
+    q3 = _kmul(p2, k, y3, t0v)
+    q4 = _kmul(p2, k, t1p, z3p)
+    q5 = _kmul(p2, k, t0v, ma)
+    q6 = _kmul(p2, k, z3p, mb)
+    p2.out_rows = _vsub(q2, q1) + _vadd(q4, q3) + _vadd(q6, q5)
+    _ADD_PLANS[k] = (p1, p2)
+    return p1, p2
+
+
+def _dbl_plans(k: int):
+    """RCB15 algorithm 9 as two plans.
+
+    Level 1 emits [w0, z8, t2n, pyz, pxy] = [Y^2, 8Y^2, b3 Z^2, YZ, XY].
+    Level 2 (with t0m = w0 - 3 t2n, y3p = w0 + t2n):
+      X3 = 2 t0m pxy,  Y3 = t2n z8 + t0m y3p,  Z3 = pyz z8.
+    """
+    if k in _DBL_PLANS:
+        return _DBL_PLANS[k]
+    p1 = plans.Plan(3 * k, 3 * k)
+    x, y, z = _vec(k, 0), _vec(k, k), _vec(k, 2 * k)
+    w0 = _ksqr(p1, k, y)
+    szz = _ksqr(p1, k, z)
+    pyz = _kmul(p1, k, y, z)
+    pxy = _kmul(p1, k, x, y)
+    p1.out_rows = w0 + _vscale(w0, 8) + _b3(k, szz) + pyz + pxy
+
+    p2 = plans.Plan(5 * k, 5 * k)
+    w0v, z8v, t2v, pyzv, pxyv = (_vec(k, i * k) for i in range(5))
+    t0m = _vsub(w0v, _vscale(t2v, 3))
+    y3p = _vadd(w0v, t2v)
+    d1 = _kmul(p2, k, t2v, z8v)
+    d2 = _kmul(p2, k, pyzv, z8v)
+    d3 = _kmul(p2, k, t0m, y3p)
+    d4 = _kmul(p2, k, t0m, pxyv)
+    p2.out_rows = _vscale(d4, 2) + _vadd(d1, d3) + d2
+    _DBL_PLANS[k] = (p1, p2)
+    return p1, p2
+
+
+# --------------------------------------------------------------------------------------
+# Point operations
+# --------------------------------------------------------------------------------------
+
+
+def point_add(k: int, p, q):
+    """Complete addition: works for any pair of on-curve points incl. infinity,
+    equal, and inverse inputs. p, q: [..., 3k, 25]."""
+    p1, p2 = _add_plans(k)
+    mid = plans.execute(p1, p, q, PUB_BOUND, PUB_BOUND, f"g{k}add1")
+    return plans.execute(p2, mid, mid, PUB_BOUND, PUB_BOUND, f"g{k}add2")
+
+
+def point_dbl(k: int, p):
+    p1, p2 = _dbl_plans(k)
+    mid = plans.execute(p1, p, p, PUB_BOUND, PUB_BOUND, f"g{k}dbl1")
+    return plans.execute(p2, mid, mid, PUB_BOUND, PUB_BOUND, f"g{k}dbl2")
+
+
+def point_neg(k: int, p):
+    """(X : -Y : Z), renormalized to public bounds."""
+    y = plans.carry_norm(tower.t_neg(p[..., k : 2 * k, :]))
+    return jnp.concatenate([p[..., 0:k, :], y, p[..., 2 * k :, :]], axis=-2)
+
+
+def point_select(cond, p, q):
+    """cond ? p : q with cond of batch shape."""
+    return jnp.where(cond[..., None, None], p, q)
+
+
+def inf_point(k: int, shape=()):
+    """(0 : 1 : 0)."""
+    z = np.zeros((3 * k, fq.NLIMBS), dtype=np.uint64)
+    z[k] = np.asarray(fq.int_to_limbs(fq.R_MONT % fq.P))
+    return jnp.broadcast_to(jnp.asarray(z), shape + (3 * k, fq.NLIMBS))
+
+
+def is_inf(k: int, p):
+    return tower.t_is_zero(p[..., 2 * k :, :])
+
+
+def point_eq(k: int, p, q):
+    """Projective equality X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1. Sound for curve
+    points: the groups have odd order, so Y = 0 never occurs and infinity
+    (0:1:0) cannot alias a finite point."""
+    x1, y1, z1 = p[..., 0:k, :], p[..., k : 2 * k, :], p[..., 2 * k :, :]
+    x2, y2, z2 = q[..., 0:k, :], q[..., k : 2 * k, :], q[..., 2 * k :, :]
+    if k == 1:
+        mul = lambda a, b: fq.mont_mul(a, b)
+    else:
+        mul = tower.fq2_mul
+    ex = tower.t_eq(mul(x1, z2), mul(x2, z1))
+    ey = tower.t_eq(mul(y1, z2), mul(y2, z1))
+    return ex & ey
+
+
+def to_affine(k: int, p):
+    """(x, y) = (X/Z, Y/Z), each [..., k, 25]; infinity maps to (0, 0) (inv0).
+    Inversion is Fermat (a^(p-2)) — wide-batch friendly."""
+    x, y, z = p[..., 0:k, :], p[..., k : 2 * k, :], p[..., 2 * k :, :]
+    if k == 1:
+        zi = fq.inv(z[..., 0, :])[..., None, :]
+        return fq.mont_mul(x, zi), fq.mont_mul(y, zi)
+    zi = tower.fq2_inv(z)
+    return tower.fq2_mul(x, zi), tower.fq2_mul(y, zi)
+
+
+def from_affine(k: int, x, y, inf=None):
+    """Affine coords -> projective; optional inf mask selects (0:1:0)."""
+    one = tower.one(k, x.shape[:-2])
+    pt = jnp.concatenate([x, y, one], axis=-2)
+    if inf is not None:
+        pt = point_select(inf, inf_point(k, x.shape[:-2]), pt)
+    return pt
+
+
+# --------------------------------------------------------------------------------------
+# Scalar multiplication (double-and-add over a bit plane; branchless select)
+# --------------------------------------------------------------------------------------
+
+
+def scale_bits(k: int, point, bits):
+    """[sum bits] * point. bits: uint64 [nbits, *batch] MSB-first; point
+    [*batch, 3k, 25]. Runs nbits scan steps of dbl + add + select."""
+    acc0 = jnp.broadcast_to(inf_point(k), point.shape)
+
+    def step(acc, bit):
+        acc = point_dbl(k, acc)
+        added = point_add(k, acc, point)
+        return point_select(bit == 1, added, acc), None
+
+    acc, _ = jax.lax.scan(step, acc0, bits)
+    return acc
+
+
+def scale_u64(k: int, point, scalars):
+    """Per-point 64-bit scalar multiply (the batch-verification random-scalar
+    path, RAND_BITS = 64 per /root/reference/crypto/bls/src/impls/blst.rs:16)."""
+    shifts = jnp.arange(63, -1, -1, dtype=jnp.uint64)
+    bits = (scalars[None, ...] >> shifts.reshape((64,) + (1,) * scalars.ndim)) & jnp.uint64(1)
+    return scale_bits(k, point, bits)
+
+
+def scale_fixed(k: int, point, e: int):
+    """Multiply by a host-fixed scalar (subgroup checks, cofactor clearing)."""
+    if e < 0:
+        return point_neg(k, scale_fixed(k, point, -e))
+    if e == 0:
+        return jnp.broadcast_to(inf_point(k), point.shape)
+    nbits = e.bit_length()
+    bits = jnp.asarray(
+        [(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=jnp.uint64
+    )
+    return scale_bits(k, point, bits)
+
+
+# --------------------------------------------------------------------------------------
+# Batch reduction (aggregation)
+# --------------------------------------------------------------------------------------
+
+
+def point_sum(k: int, pts, valid=None):
+    """Sum points over the leading batch axis by halving tree reduction
+    (log2(n) point_add kernels, each on a halved batch). pts: [n, *batch, 3k, 25].
+    ``valid`` ([n, *batch] bool) masks entries (invalid -> infinity)."""
+    n = pts.shape[0]
+    if valid is not None:
+        pts = point_select(valid, pts, jnp.broadcast_to(inf_point(k), pts.shape))
+    while n > 1:
+        if n % 2:
+            pts = jnp.concatenate(
+                [pts, jnp.broadcast_to(inf_point(k), (1,) + pts.shape[1:])], axis=0
+            )
+            n += 1
+        pts = point_add(k, pts[: n // 2], pts[n // 2 :])
+        n //= 2
+    return pts[0]

@@ -68,7 +68,7 @@ def _inflated_2p() -> np.ndarray:
     c = [int(v) for v in int_to_limbs(2 * P)]
     top = max(i for i, v in enumerate(c) if v)
     for i in range(1, top + 1):
-        c[i - 1] += (1 << LIMB_BITS) if i <= top else 0
+        c[i - 1] += 1 << LIMB_BITS
         c[i] -= 1
     # re-add: above loop borrowed 1 from each c_i (1..top) into c_{i-1}
     assert sum(v << (LIMB_BITS * i) for i, v in enumerate(c)) == 2 * P

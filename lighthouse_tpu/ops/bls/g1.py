@@ -1,0 +1,141 @@
+"""G1 (E(Fq): y^2 = x^3 + 4) device kernels.
+
+Thin instantiation of curve.py with k = 1 plus G1-specific pieces: the GLV
+endomorphism subgroup check and batched decompression. Parity targets:
+``/root/reference/crypto/bls/src/generic_public_key.rs`` (48-byte pubkeys) and
+blst ``key_validate`` used at ``impls/blst.rs:75``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import curve, fq, plans, tower
+from ..bls_oracle.fields import P
+from ..bls_oracle import curves as _oc
+
+K = 1
+
+# GLV endomorphism phi(x, y) = (BETA x, y) acts as multiplication by -u^2 on the
+# r-order subgroup (BETA is the cube root of unity below; verified against the
+# oracle in tests). Subgroup check: phi(P) == -[u^2] P  (Scott, eprint 2021/1130).
+BETA = 0x5F19672FDF76CE51BA69C6076A0F77EADDB3A93BE6F89688DE17D813620A00022E01FFFFFFFEFFFE
+
+from ..bls_oracle.fields import BLS_X as _X
+
+U2 = _X * _X  # positive 127-bit scalar
+
+_BETA_M = jnp.asarray(fq.int_to_limbs(BETA * fq.R_MONT % P))
+
+
+def generator(shape=()):
+    g = curve.from_affine(
+        K, fq.from_int(_oc.G1_X)[None, :], fq.from_int(_oc.G1_Y)[None, :]
+    )
+    return jnp.broadcast_to(g, shape + (3, fq.NLIMBS)) if shape else g
+
+
+def add(p, q):
+    return curve.point_add(K, p, q)
+
+
+def dbl(p):
+    return curve.point_dbl(K, p)
+
+
+def neg(p):
+    return curve.point_neg(K, p)
+
+
+def scale_u64(p, scalars):
+    return curve.scale_u64(K, p, scalars)
+
+
+def scale_fixed(p, e: int):
+    return curve.scale_fixed(K, p, e)
+
+
+def psum(pts, valid=None):
+    return curve.point_sum(K, pts, valid)
+
+
+def to_affine(p):
+    return curve.to_affine(K, p)
+
+
+def is_inf(p):
+    return curve.is_inf(K, p)
+
+
+def eq(p, q):
+    return curve.point_eq(K, p, q)
+
+
+def phi(p):
+    """GLV endomorphism on projective coords: (BETA X : Y : Z)."""
+    x = fq.mont_mul(p[..., 0:1, :], jnp.broadcast_to(_BETA_M, p.shape[:-2] + (1, fq.NLIMBS)))
+    return jnp.concatenate([x, p[..., 1:, :]], axis=-2)
+
+
+def subgroup_check(p):
+    """phi(P) == -[u^2]P. Infinity passes (blst key_validate rejects infinity
+    separately at the key-validation layer)."""
+    return curve.point_eq(K, phi(p), curve.point_neg(K, scale_fixed(p, U2)))
+
+
+def on_curve(p):
+    """Projective on-curve check Y^2 Z == X^3 + 4 Z^3 (infinity passes)."""
+    x, y, z = p[..., 0:1, :], p[..., 1:2, :], p[..., 2:3, :]
+    y2z = fq.mont_mul(fq.mont_mul(y, y), z)
+    x3 = fq.mont_mul(fq.mont_mul(x, x), x)
+    z3 = fq.mont_mul(fq.mont_mul(z, z), z)
+    rhs = plans.carry_norm(x3 + z3 * np.uint64(4))
+    return tower.t_eq(y2z, rhs)
+
+
+# --------------------------------------------------------------------------------------
+# Batched decompression: x limbs + sign flag -> affine point (+ validity)
+# --------------------------------------------------------------------------------------
+
+
+def decompress(x_mont, s_flag):
+    """x_mont [..., 1, 25] Montgomery-form x; s_flag [...] (0/1 lex-largest-y bit).
+    Returns (point [..., 3, 25], ok [...]): ok = x is on curve. Infinity/flag
+    parsing happens host-side (the byte layer)."""
+    x = x_mont
+    x3b = plans.carry_norm(
+        fq.mont_mul(fq.mont_mul(x, x), x) + tower.one(1, x.shape[:-2]) * np.uint64(4)
+    )
+    y = fq.sqrt_candidate(x3b[..., 0, :])
+    ok = fq.eq(fq.mont_mul(y, y), fq.normalize(x3b[..., 0, :]))
+    big = fq.lex_gt_half(y)
+    y = plans.carry_norm(fq.select(big ^ (s_flag == 1), fq.neg(y), y))
+    return curve.from_affine(K, x, y[..., None, :]), ok
+
+
+# --------------------------------------------------------------------------------------
+# Host conversions (oracle interop)
+# --------------------------------------------------------------------------------------
+
+
+def from_oracle(p):
+    """Oracle affine point (or None) -> device projective [3, 25]."""
+    if p is None:
+        return curve.inf_point(K)
+    return jnp.concatenate(
+        [fq.from_int(p[0])[None], fq.from_int(p[1])[None], tower.one(1)], axis=0
+    )
+
+
+def from_oracle_batch(pts):
+    return jnp.stack([from_oracle(p) for p in pts])
+
+
+def to_oracle(p):
+    """Device projective point -> oracle affine (or None)."""
+    if bool(np.asarray(is_inf(p))):
+        return None
+    x, y = to_affine(p)
+    return (fq.to_int(np.asarray(x)[0]), fq.to_int(np.asarray(y)[0]))

@@ -1,0 +1,160 @@
+"""G2 (E'(Fq2): y^2 = x^3 + 4(u+1)) device kernels.
+
+Instantiation of curve.py with k = 2 plus the psi (untwist-Frobenius-twist)
+endomorphism: the fast subgroup check psi(Q) == [x]Q (the check blst performs
+for signature group-checks, ``/root/reference/crypto/bls/src/impls/blst.rs:75``)
+and, later, fast cofactor clearing for hash-to-curve. Signatures are 96-byte
+compressed G2 points (``generic_signature.rs``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import curve, fq, plans, tower
+from ..bls_oracle.fields import P, BLS_X, Fq2
+from ..bls_oracle import curves as _oc
+
+K = 2
+
+# psi(x, y) = (CX * conj(x), CY * conj(y)) acts as multiplication by x (the BLS
+# parameter) on the r-order subgroup; constants derived from the twist
+# nonresidue xi = 1 + u and verified against the oracle in tests.
+_XI = Fq2(1, 1)
+_CX = _XI.pow((P - 1) // 3).inv()
+_CY = _XI.pow((P - 1) // 2).inv()
+
+_CX_M = tower.from_ints([_CX.c0, _CX.c1])
+_CY_M = tower.from_ints([_CY.c0, _CY.c1])
+
+B2_M = tower.from_ints([4, 4])  # curve constant 4(u+1), Montgomery form
+
+
+def generator(shape=()):
+    g = curve.from_affine(
+        K,
+        tower.from_ints([_oc.G2_X.c0, _oc.G2_X.c1]),
+        tower.from_ints([_oc.G2_Y.c0, _oc.G2_Y.c1]),
+    )
+    return jnp.broadcast_to(g, shape + (6, fq.NLIMBS)) if shape else g
+
+
+def add(p, q):
+    return curve.point_add(K, p, q)
+
+
+def dbl(p):
+    return curve.point_dbl(K, p)
+
+
+def neg(p):
+    return curve.point_neg(K, p)
+
+
+def scale_u64(p, scalars):
+    return curve.scale_u64(K, p, scalars)
+
+
+def scale_fixed(p, e: int):
+    return curve.scale_fixed(K, p, e)
+
+
+def psum(pts, valid=None):
+    return curve.point_sum(K, pts, valid)
+
+
+def to_affine(p):
+    return curve.to_affine(K, p)
+
+
+def is_inf(p):
+    return curve.is_inf(K, p)
+
+
+def eq(p, q):
+    return curve.point_eq(K, p, q)
+
+
+def psi(p):
+    """Endomorphism on projective coords: (CX conj(X) : CY conj(Y) : conj(Z))."""
+    x, y, z = p[..., 0:2, :], p[..., 2:4, :], p[..., 4:6, :]
+    conj = lambda a: plans.carry_norm(tower.fq2_conj(a))
+    xn = tower.fq2_mul(conj(x), jnp.broadcast_to(_CX_M, x.shape))
+    yn = tower.fq2_mul(conj(y), jnp.broadcast_to(_CY_M, y.shape))
+    return jnp.concatenate([xn, yn, conj(z)], axis=-2)
+
+
+def subgroup_check(p):
+    """psi(Q) == [x]Q (x = BLS_X < 0). Infinity passes — callers gate it."""
+    xq = curve.point_neg(K, scale_fixed(p, -BLS_X))
+    return curve.point_eq(K, psi(p), xq)
+
+
+def on_curve(p):
+    """Y^2 Z == X^3 + 4(u+1) Z^3 (infinity passes)."""
+    x, y, z = p[..., 0:2, :], p[..., 2:4, :], p[..., 4:6, :]
+    y2z = tower.fq2_mul(tower.fq2_sqr(y), z)
+    x3 = tower.fq2_mul(tower.fq2_sqr(x), x)
+    z3 = tower.fq2_mul(tower.fq2_sqr(z), z)
+    rhs = plans.carry_norm(x3 + tower.fq2_mul(z3, jnp.broadcast_to(B2_M, z3.shape)))
+    return tower.t_eq(y2z, rhs)
+
+
+# --------------------------------------------------------------------------------------
+# Sign / decompression
+# --------------------------------------------------------------------------------------
+
+
+def lex_sign(y):
+    """ZCash G2 sign bit: c1 > (p-1)/2 if c1 != 0 else c0 > (p-1)/2."""
+    c0, c1 = y[..., 0, :], y[..., 1, :]
+    c1z = fq.is_zero(fq.from_mont(tower.t_canon(y))[..., 1, :])
+    return jnp.where(c1z, fq.lex_gt_half(c0), fq.lex_gt_half(c1))
+
+
+def decompress(x_mont, s_flag):
+    """x_mont [..., 2, 25] Montgomery-form x; s_flag [...]. Returns
+    (point [..., 6, 25], ok [...]): ok = x is on curve (y^2 solvable).
+    Infinity/flag parsing happens host-side."""
+    x = x_mont
+    rhs = plans.carry_norm(
+        tower.fq2_mul(tower.fq2_sqr(x), x)
+        + jnp.broadcast_to(B2_M, x.shape)
+    )
+    y, ok = tower.fq2_sqrt(rhs)
+    flip = lex_sign(y) ^ (s_flag == 1)
+    y = plans.carry_norm(tower.t_select(flip, tower.fq2_neg(tower.t_canon(y)), y))
+    return curve.from_affine(K, x, y), ok
+
+
+# --------------------------------------------------------------------------------------
+# Host conversions (oracle interop)
+# --------------------------------------------------------------------------------------
+
+
+def from_oracle(p):
+    if p is None:
+        return curve.inf_point(K)
+    return jnp.concatenate(
+        [
+            tower.from_ints([p[0].c0, p[0].c1]),
+            tower.from_ints([p[1].c0, p[1].c1]),
+            tower.one(2),
+        ],
+        axis=0,
+    )
+
+
+def from_oracle_batch(pts):
+    return jnp.stack([from_oracle(p) for p in pts])
+
+
+def to_oracle(p):
+    if bool(np.asarray(is_inf(p))):
+        return None
+    x, y = to_affine(p)
+    xi = tower.to_ints(np.asarray(tower.t_canon(x)))
+    yi = tower.to_ints(np.asarray(tower.t_canon(y)))
+    return (Fq2(*xi), Fq2(*yi))

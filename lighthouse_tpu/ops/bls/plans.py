@@ -299,7 +299,7 @@ def lincomb(rows: list[LC], x, in_bound: _Bound, name: str = "", bound_for=None)
             base = jnp.asarray(subc) - neg
             pos = base if pos is None else pos + base
             value_p += K
-            limb += int(subc[0])
+            limb += int(max(subc[:24]))
             top += int(subc[24])
         elif pos is None:
             pos = jnp.zeros_like(x[..., 0, :])

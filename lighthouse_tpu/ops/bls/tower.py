@@ -56,8 +56,8 @@ def t_select(cond, a, b):
 
 def t_canon(a):
     """Fully reduce each coefficient mod p (for comparisons / serialization):
-    one stacked Montgomery multiply by R."""
-    return fq.mont_mul(a, jnp.broadcast_to(fq.ONE_M, a.shape))
+    one stacked Montgomery multiply by R (same op as fq.normalize)."""
+    return fq.normalize(a)
 
 
 def t_eq(a, b):
@@ -83,11 +83,7 @@ def one(k: int, shape=()):
 
 def from_ints(coeffs, mont: bool = True):
     """list of k ints -> [k, 25]."""
-    return jnp.asarray(
-        np.stack(
-            [fq.int_to_limbs(c % _of.P * (fq.R_MONT if mont else 1) % _of.P) for c in coeffs]
-        )
-    )
+    return fq.from_ints(coeffs, mont)
 
 
 def to_ints(a, mont: bool = True):
@@ -226,7 +222,6 @@ def fq2_sqrt(a):
 
 # Stacked many-muls: k independent fq2 products in one kernel (for curve formulas).
 _MUL2_MANY: dict[int, plans.Plan] = {}
-_SQR2_MANY: dict[int, plans.Plan] = {}
 
 
 def _mul2_many_plan(k: int) -> plans.Plan:
@@ -287,7 +282,7 @@ def fq6_inv(a):
     lazy = t0_b | t1_b | t2_b
     m0, m1, m2 = fq2_mul_many([(a0, t0), (a2, t1), (a1, t2)], in_bound=lazy)
     denom = fq2_add(m0, fq2_mul_by_nonresidue(fq2_add(m1, m2), PUB.scaled(2)))
-    dinv = fq2_inv(t_canon(denom))
+    dinv = fq2_inv(denom)
     r0, r1, r2 = fq2_mul_many(
         [(t0, dinv), (t1, dinv), (t2, dinv)], in_bound=lazy
     )
