@@ -1,0 +1,66 @@
+"""Headline benchmark: batched BLS12-381 verification kernel throughput.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The baseline column is measured on this machine at runtime: the pure-Python
+oracle backend performing the same work (the portable CPU fallback). Once the
+native CPU backend lands, vs_baseline switches to that. The metric tracks the
+north star in BASELINE.json: aggregate-signature verification throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_device(n_sets: int) -> float:
+    import jax
+
+    from __graft_entry__ import _example_batch
+    from lighthouse_tpu.ops.bls import g1
+
+    pts, scalars = _example_batch(n_sets)
+    step = jax.jit(lambda p, s: g1.psum(g1.scale_u64(p, s)))
+    step(pts, scalars).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        step(pts, scalars).block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    return n_sets / dt
+
+
+def _bench_oracle(n_sets: int) -> float:
+    from lighthouse_tpu.ops.bls_oracle import curves as oc
+
+    pts = [oc.g1_mul(oc.g1_generator(), 7 * i + 3) for i in range(n_sets)]
+    scalars = [
+        (0x9E3779B97F4A7C15 * (i + 1)) & 0xFFFFFFFFFFFFFFFF for i in range(n_sets)
+    ]
+    t0 = time.perf_counter()
+    oc.g1_msm(pts, scalars)
+    dt = time.perf_counter() - t0
+    return n_sets / dt
+
+
+def main():
+    n_dev, n_cpu = 256, 16
+    dev = _bench_device(n_dev)
+    cpu = _bench_oracle(n_cpu)
+    print(
+        json.dumps(
+            {
+                "metric": "g1_randexp_aggregate_points_per_s",
+                "value": round(dev, 2),
+                "unit": "points/s",
+                "vs_baseline": round(dev / cpu, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

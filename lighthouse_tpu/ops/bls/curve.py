@@ -235,7 +235,9 @@ def from_affine(k: int, x, y, inf=None):
 def scale_bits(k: int, point, bits):
     """[sum bits] * point. bits: uint64 [nbits, *batch] MSB-first; point
     [*batch, 3k, 25]. Runs nbits scan steps of dbl + add + select."""
-    acc0 = jnp.broadcast_to(inf_point(k), point.shape)
+    # Derive the initial carry from `point` (0*point + inf) so its device-varying
+    # type matches the scan output under shard_map (see shard_map scan-vma docs).
+    acc0 = point * jnp.uint64(0) + jnp.broadcast_to(inf_point(k), point.shape)
 
     def step(acc, bit):
         acc = point_dbl(k, acc)

@@ -302,8 +302,11 @@ def fq12_sqr(a, in_bound=PUB_BOUND):
 
 
 def fq12_conj(a):
-    """p^6 Frobenius: negate the w coefficient (last 6 fq coefficients)."""
-    return jnp.concatenate([a[..., 0:6, :], fq6_neg(a[..., 6:12, :])], axis=-2)
+    """p^6 Frobenius: negate the w coefficient (last 6 fq coefficients).
+    Output is carry-normalized so downstream plans' PUB_BOUND contract holds."""
+    return jnp.concatenate(
+        [a[..., 0:6, :], plans.carry_norm(fq6_neg(a[..., 6:12, :]))], axis=-2
+    )
 
 
 def fq12_inv(a):
@@ -312,7 +315,7 @@ def fq12_inv(a):
     s1 = fq6_mul(a1, a1)
     t = fq6_inv(t_canon(t_sub(s0, fq6_nr(s1), nr_bound(PUB_BOUND))))
     c0 = fq6_mul(a0, t)
-    c1 = fq6_neg(fq6_mul(a1, t))
+    c1 = plans.carry_norm(fq6_neg(fq6_mul(a1, t)))
     return jnp.concatenate([c0, c1], axis=-2)
 
 

@@ -1,0 +1,116 @@
+"""Device pairing validation against the oracle.
+
+The oracle's affine Miller loop and the device's projective CLN loop produce
+different unreduced representatives (they differ by Fq2 subfield factors), so
+agreement is asserted *after* final exponentiation — both compute e(P, Q)^3.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu.ops.bls import fq, g1, g2, pairing as dp, tower as tw
+from lighthouse_tpu.ops.bls_oracle import curves as oc, fields as of
+import importlib
+
+op = importlib.import_module("lighthouse_tpu.ops.bls_oracle.pairing")
+
+rng = random.Random(0xA17)
+
+
+def _g1_aff(k: int):
+    p = oc.g1_mul(oc.g1_generator(), k)
+    return fq.from_int(p[0]), fq.from_int(p[1]), p
+
+
+def _g2_aff(k: int):
+    q = oc.g2_mul(oc.g2_generator(), k)
+    return (
+        tw.from_ints([q[0].c0, q[0].c1]),
+        tw.from_ints([q[1].c0, q[1].c1]),
+        q,
+    )
+
+
+def _pairing_jit():
+    return jax.jit(dp.pairing)
+
+
+class TestPairing:
+    def test_matches_oracle(self):
+        k1, k2 = rng.randrange(1, of.R), rng.randrange(1, of.R)
+        px, py, p = _g1_aff(k1)
+        qx, qy, q = _g2_aff(k2)
+        f = _pairing_jit()(px, py, qx, qy)
+        assert tw.fq12_to_oracle(f) == op.pairing(p, q)
+
+    def test_bilinearity_batched(self):
+        """e(aP, Q) == e(P, aQ) == e(P, Q)^a, computed in one batched call."""
+        a, k1, k2 = 7, rng.randrange(1, of.R), rng.randrange(1, of.R)
+        pxa, pya, _ = _g1_aff(k1 * a)
+        qx0, qy0, _ = _g2_aff(k2)
+        px0, py0, _ = _g1_aff(k1)
+        qxa, qya, _ = _g2_aff(k2 * a)
+        px = jnp.stack([pxa, px0])
+        py = jnp.stack([pya, py0])
+        qx = jnp.stack([qx0, qxa])
+        qy = jnp.stack([qy0, qya])
+        fs = jax.jit(dp.miller_loop)(px, py, qx, qy)
+        f0 = dp.final_exponentiation(fs[0])
+        f1 = dp.final_exponentiation(fs[1])
+        assert tw.fq12_to_oracle(f0) == tw.fq12_to_oracle(f1)
+
+    def test_multi_pairing_is_one_with_mask(self):
+        """e(P, Q) * e(-P, Q) == 1; a masked garbage entry must not disturb it."""
+        k1, k2 = 11, 13
+        px, py, p = _g1_aff(k1)
+        qx, qy, q = _g2_aff(k2)
+        pn = oc.g1_neg(p)
+        pxn, pyn = fq.from_int(pn[0]), fq.from_int(pn[1])
+        # garbage third entry (affine inf -> (0,0)) masked out by `valid`
+        zx, zy = fq.from_int(0), fq.from_int(0)
+        zqx = tw.from_ints([0, 0])
+        pxs = jnp.stack([px, pxn, zx])
+        pys = jnp.stack([py, pyn, zy])
+        qxs = jnp.stack([qx, qx, zqx])
+        qys = jnp.stack([qy, qy, zqx])
+        valid = jnp.asarray([True, True, False])
+        ok = jax.jit(dp.multi_pairing_is_one)(pxs, pys, qxs, qys, valid)
+        assert bool(ok)
+        # flip one sign: product != 1
+        bad = jax.jit(dp.multi_pairing_is_one)(
+            jnp.stack([px, px, zx]), jnp.stack([py, py, zy]), qxs, qys, valid
+        )
+        assert not bool(bad)
+
+    def test_final_exponentiation_matches_oracle(self):
+        co = [rng.randrange(of.P) for _ in range(12)]
+        a = tw.from_ints(co)
+        f2 = lambda i: of.Fq2(co[i], co[i + 1])
+        ora = of.Fq12(
+            of.Fq6(f2(0), f2(2), f2(4)), of.Fq6(f2(6), f2(8), f2(10))
+        )
+        out = jax.jit(dp.final_exponentiation)(a)
+        assert tw.fq12_to_oracle(out) == op.final_exponentiation(ora)
+
+    def test_mul_by_014(self):
+        co = [rng.randrange(of.P) for _ in range(12)]
+        cs = [rng.randrange(of.P) for _ in range(6)]
+        a = tw.from_ints(co)
+        c = tw.from_ints(cs)
+        f2 = lambda v, i: of.Fq2(v[i], v[i + 1])
+        ora = of.Fq12(
+            of.Fq6(f2(co, 0), f2(co, 2), f2(co, 4)),
+            of.Fq6(f2(co, 6), f2(co, 8), f2(co, 10)),
+        )
+        sparse = of.Fq12(
+            of.Fq6(f2(cs, 0), f2(cs, 2), of.Fq2.ZERO),
+            of.Fq6(of.Fq2.ZERO, f2(cs, 4), of.Fq2.ZERO),
+        )
+        out = jax.jit(dp.mul_by_014)(a, c)
+        assert tw.fq12_to_oracle(out) == ora * sparse
