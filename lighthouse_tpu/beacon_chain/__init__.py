@@ -1,0 +1,4 @@
+"""Beacon chain runtime (beacon_node/beacon_chain twin)."""
+
+from .chain import BeaconChain, BlockError
+from .pubkey_cache import ValidatorPubkeyCache

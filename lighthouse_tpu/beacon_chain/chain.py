@@ -1,0 +1,322 @@
+"""The BeaconChain orchestrator.
+
+Twin of ``/root/reference/beacon_node/beacon_chain/src/beacon_chain.rs``:
+``process_block`` (:3289) with the typestate pipeline collapsed into explicit
+stages (gossip checks → batched signature verification → state transition →
+``import_block`` (:3717) store writes + fork-choice update), attestation
+verification with the batch path (``attestation_verification/batch.rs``),
+head tracking (``canonical_head.rs:474``), and block production
+(``produce_block_with_verification``, :4553).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import bls
+from ..fork_choice import ForkChoice
+from ..fork_choice.proto_array import ExecutionStatus
+from ..state_transition import (
+    BlockSignatureStrategy,
+    get_beacon_proposer_index,
+    get_current_epoch,
+    get_indexed_attestation,
+    per_block_processing,
+    process_slots,
+)
+from ..state_transition.per_block import BlockProcessingError, ConsensusContext
+from ..state_transition import signature_sets as sigs
+from ..store import HotColdDB
+from ..types.containers import for_preset
+from ..types.spec import ChainSpec
+from ..utils.slot_clock import ManualSlotClock, SlotClock
+from .pubkey_cache import ValidatorPubkeyCache
+
+
+class BlockError(Exception):
+    pass
+
+
+class AttestationError(Exception):
+    pass
+
+
+@dataclass
+class ChainHead:
+    root: bytes
+    slot: int
+    state: object
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        genesis_state,
+        store: HotColdDB | None = None,
+        slot_clock: SlotClock | None = None,
+    ):
+        self.spec = spec
+        self.ns = for_preset(spec.preset.name)
+        self.store = store or HotColdDB()
+        self.slot_clock = slot_clock or ManualSlotClock(0)
+        self.pubkey_cache = ValidatorPubkeyCache()
+        self.pubkey_cache.import_new_pubkeys(genesis_state)
+
+        # genesis anchor: the canonical block root needs the header's
+        # state_root filled (it is zero until the next process_slot)
+        hdr = genesis_state.latest_block_header.copy()
+        if bytes(hdr.state_root) == b"\x00" * 32:
+            hdr.state_root = genesis_state.tree_root()
+        genesis_root = hdr.tree_root()
+        self.genesis_state = genesis_state
+        self.genesis_block_root = genesis_root
+        jc = (0, genesis_root)
+        self.fork_choice = ForkChoice.from_anchor(
+            spec,
+            genesis_root,
+            genesis_state.slot,
+            jc,
+            jc,
+            np.asarray(genesis_state.balances, dtype=np.uint64),
+        )
+        self._states: dict[bytes, object] = {genesis_root: genesis_state}
+        self._blocks: dict[bytes, object] = {}
+        self.head = ChainHead(
+            root=genesis_root, slot=genesis_state.slot, state=genesis_state
+        )
+        self._seen_blocks: set[bytes] = {genesis_root}
+
+    # -- time --------------------------------------------------------------------
+
+    def current_slot(self) -> int:
+        return self.slot_clock.now() or 0
+
+    # -- block import pipeline -----------------------------------------------------
+
+    def get_state_for_block(self, parent_root: bytes, slot: int):
+        parent_state = self._states.get(parent_root)
+        if parent_state is None:
+            raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
+        state = parent_state.copy()
+        if state.slot < slot:
+            process_slots(self.spec, state, slot)
+        return state
+
+    def process_block(self, signed_block, is_first_block_in_slot: bool = True) -> bytes:
+        """Full import: signature batch verify + state transition + store +
+        fork choice. Returns the block root."""
+        block = signed_block.message
+        block_root = type(block).hash_tree_root(block)
+        if block_root in self._seen_blocks:
+            return block_root
+        if block.slot > self.current_slot():
+            raise BlockError("block from the future")
+
+        state = self.get_state_for_block(bytes(block.parent_root), block.slot)
+        ctxt = ConsensusContext()
+        ctxt.get_pubkey_index = self.pubkey_cache.get_index
+        try:
+            ctxt = per_block_processing(
+                self.spec,
+                state,
+                signed_block,
+                strategy=BlockSignatureStrategy.VERIFY_BULK,
+                ctxt=ctxt,
+                get_pubkey=self.pubkey_cache.get,
+            )
+        except (BlockProcessingError, bls.BlsError) as e:
+            raise BlockError(str(e)) from None
+        self._import_block(
+            signed_block, block_root, state, ctxt,
+            is_first_block_in_slot=is_first_block_in_slot,
+        )
+        return block_root
+
+    def process_chain_segment(self, blocks) -> list:
+        """Batch-verify ALL signatures of a segment in one bls call, then
+        apply blocks with NoVerification (signature_verify_chain_segment,
+        block_verification.rs:590-636)."""
+        from ..state_transition.per_block import BlockSignatureVerifier
+
+        roots = []
+        if not blocks:
+            return roots
+        # thread ONE state through the segment: collect each block's signature
+        # sets against its pre-state, apply the transition unverified, and
+        # only import after the whole segment's batch verifies
+        first = blocks[0].message
+        state = self.get_state_for_block(bytes(first.parent_root), first.slot)
+        all_sets = []
+        prepared = []
+        for sb in blocks:
+            block = sb.message
+            if state.slot < block.slot:
+                process_slots(self.spec, state, block.slot)
+            v = BlockSignatureVerifier(self.spec, state, self.pubkey_cache.get)
+            ctxt = ConsensusContext()
+            ctxt.get_pubkey_index = self.pubkey_cache.get_index
+            v.include_all_signatures(sb, ctxt)
+            all_sets.extend(v.sets)
+            per_block_processing(
+                self.spec, state, sb,
+                strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                ctxt=ctxt,
+            )
+            prepared.append((sb, state.copy(), ctxt))
+        if not bls.verify_signature_sets(all_sets):
+            raise BlockError("chain segment signature verification failed")
+        for sb, post_state, ctxt in prepared:
+            block = sb.message
+            root = type(block).hash_tree_root(block)
+            self._import_block(sb, root, post_state, ctxt)
+            roots.append(root)
+        return roots
+
+    def _justified_balances(self, justified_root: bytes, fallback_state):
+        """Effective balances of validators active at the justified epoch,
+        zero otherwise (BeaconForkChoiceStore/JustifiedBalances parity)."""
+        from ..types.helpers import is_active_validator
+
+        state = self._states.get(justified_root, fallback_state)
+        epoch = get_current_epoch(self.spec, state)
+        return np.array(
+            [
+                v.effective_balance if is_active_validator(v, epoch) else 0
+                for v in state.validators
+            ],
+            dtype=np.uint64,
+        )
+
+    def _import_block(
+        self, signed_block, block_root, state, ctxt,
+        is_first_block_in_slot: bool = True,
+    ) -> None:
+        block = signed_block.message
+        self.pubkey_cache.import_new_pubkeys(state)
+        self.store.put_block(block_root, type(signed_block).encode(signed_block))
+        state_ssz = type(state).encode(state)
+        self.store.put_state(state.tree_root(), state_ssz, state.slot)
+        self._states[block_root] = state
+        self._blocks[block_root] = signed_block
+        self._seen_blocks.add(block_root)
+
+        self.fork_choice.on_block(
+            self.current_slot(),
+            block,
+            block_root,
+            state,
+            justified_balances=self._justified_balances(
+                bytes(state.current_justified_checkpoint.root), state
+            ),
+            execution_status=ExecutionStatus.IRRELEVANT,
+            is_first_block_in_slot=is_first_block_in_slot,
+        )
+        # apply the block's attestations to fork choice (import_block does)
+        for indexed in ctxt.indexed_attestations.values():
+            try:
+                self.fork_choice.on_attestation(
+                    self.current_slot(), indexed, is_from_block=True
+                )
+            except Exception:
+                pass
+        self.recompute_head()
+
+    # -- attestations ---------------------------------------------------------------
+
+    def verify_unaggregated_attestations(self, attestations) -> list:
+        """Batch gossip verification: one signature set per attestation, one
+        bls batch; on failure re-verify individually
+        (batch_verify_unaggregated_attestations, batch.rs:133-211).
+        Returns list of (attestation, indexed | error)."""
+        prepared = []
+        for att in attestations:
+            try:
+                state = self._attestation_state(att)
+                indexed = get_indexed_attestation(self.spec, state, att)
+                s = sigs.indexed_attestation_signature_set(
+                    self.spec, state, indexed, self.pubkey_cache.get
+                )
+                prepared.append((att, indexed, s))
+            except Exception as e:
+                prepared.append((att, AttestationError(str(e)), None))
+        sets = [p[2] for p in prepared if p[2] is not None]
+        results = []
+        if sets and bls.verify_signature_sets(sets):
+            for att, indexed, s in prepared:
+                results.append((att, indexed))
+        else:
+            # poisoned batch: per-set fallback keeps exact error fidelity
+            for att, indexed, s in prepared:
+                if s is None:
+                    results.append((att, indexed))
+                elif bls.verify_signature_sets([s]):
+                    results.append((att, indexed))
+                else:
+                    results.append(
+                        (att, AttestationError("invalid attestation signature"))
+                    )
+        for att, indexed in results:
+            if not isinstance(indexed, Exception):
+                try:
+                    self.fork_choice.on_attestation(self.current_slot(), indexed)
+                except Exception:
+                    pass
+        return results
+
+    def _attestation_state(self, att):
+        root = bytes(att.data.beacon_block_root)
+        state = self._states.get(root)
+        if state is None:
+            raise AttestationError("unknown beacon block root")
+        if state.slot < att.data.slot:
+            state = state.copy()
+            process_slots(self.spec, state, att.data.slot)
+        return state
+
+    # -- head ------------------------------------------------------------------------
+
+    def recompute_head(self) -> bytes:
+        head_root = self.fork_choice.get_head(self.current_slot())
+        if head_root != self.head.root:
+            state = self._states.get(head_root)
+            if state is not None:
+                self.head = ChainHead(
+                    root=head_root, slot=state.slot, state=state
+                )
+        return self.head.root
+
+    # -- production -------------------------------------------------------------------
+
+    def produce_block_on_state(self, state, slot, randao_reveal, attestations=None,
+                               graffiti: bytes = b"\x00" * 32):
+        spec = self.spec
+        state = state.copy()
+        if state.slot < slot:
+            process_slots(spec, state, slot)
+        proposer = get_beacon_proposer_index(spec, state)
+        parent_root = state.latest_block_header.tree_root()
+        fork = spec.fork_name_at_epoch(get_current_epoch(spec, state))
+        body_cls = self.ns.body_types[fork]
+        block_cls = self.ns.block_types[fork]
+        body = body_cls(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            graffiti=graffiti,
+            attestations=attestations or [],
+        )
+        inner_cls = dict(block_cls.FIELDS)["message"]
+        block = inner_cls(
+            slot=slot, proposer_index=proposer, parent_root=parent_root,
+            state_root=b"\x00" * 32, body=body,
+        )
+        trial = state.copy()
+        per_block_processing(
+            spec, trial, block_cls(message=block, signature=b"\x00" * 96),
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            verify_block_root=False,
+        )
+        block.state_root = trial.tree_root()
+        return block, trial

@@ -1,0 +1,74 @@
+"""Validator pubkey cache: every key decompressed once, resident for batches.
+
+Parity: ``/root/reference/beacon_node/beacon_chain/src/validator_pubkey_cache.rs:12-25``
+— "keeps all validator pubkeys decompressed in memory". TPU-first upgrade: in
+addition to host-side oracle points (for the CPU backend), the cache maintains
+a device-resident projective-coordinate array ``[n, 3, 25]`` so batched
+verification gathers keys on device without per-batch H2D of 48-byte blobs
+(SURVEY §7.6: the feed for 1M-validator batches).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import bls
+from ..ops.bls_oracle import curves as oc
+
+
+class ValidatorPubkeyCache:
+    def __init__(self):
+        self._points: list = []          # oracle affine points
+        self._pubkeys: list[bls.PublicKey] = []
+        self._bytes_to_index: dict[bytes, int] = {}
+        self._device = None              # [n, 3, 25] uint64 (lazily built)
+        self._device_len = 0
+
+    def __len__(self) -> int:
+        return len(self._points)
+
+    def import_new_pubkeys(self, state) -> None:
+        """Decompress + subgroup-check any validators beyond the cache length
+        (import_new_pubkeys in the reference; invalid keys are impossible in a
+        valid state, so errors raise)."""
+        for v in state.validators[len(self._points):]:
+            pk_bytes = bytes(v.pubkey)
+            pk = bls.PublicKey.from_bytes(pk_bytes)
+            self._bytes_to_index[pk_bytes] = len(self._points)
+            self._points.append(pk.point)
+            self._pubkeys.append(pk)
+
+    def get(self, index: int) -> bls.PublicKey | None:
+        return self._pubkeys[index] if index < len(self._pubkeys) else None
+
+    def get_index(self, pubkey_bytes: bytes) -> int | None:
+        return self._bytes_to_index.get(bytes(pubkey_bytes))
+
+    def get_point(self, index: int):
+        return self._points[index] if index < len(self._points) else None
+
+    # -- device residency --------------------------------------------------------
+
+    def device_array(self):
+        """[n, 3, 25] device projective points, built incrementally."""
+        import jax.numpy as jnp
+
+        from ..ops.bls import g1
+
+        n = len(self._points)
+        if self._device is None or self._device_len < n:
+            new = g1.from_oracle_batch(self._points[self._device_len:])
+            self._device = (
+                new
+                if self._device is None
+                else jnp.concatenate([self._device, new], axis=0)
+            )
+            self._device_len = n
+        return self._device
+
+    def device_gather(self, indices) -> "object":
+        """Gather [k, 3, 25] pubkey points for validator indices on device."""
+        arr = self.device_array()
+        import jax.numpy as jnp
+
+        return arr[jnp.asarray(np.asarray(indices, dtype=np.int64))]

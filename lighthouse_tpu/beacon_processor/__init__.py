@@ -1,0 +1,10 @@
+"""Work scheduler (beacon_node/beacon_processor twin)."""
+
+from .processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    Work,
+    WorkType,
+    QueueLengths,
+)
+from .reprocess import ReprocessQueue

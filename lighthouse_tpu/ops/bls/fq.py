@@ -250,7 +250,10 @@ def pow_fixed_scan(a, e: int):
         res = select(bit == 1, mont_mul(res, a), res)
         return res, None
 
-    res, _ = jax.lax.scan(step, jnp.broadcast_to(ONE_M, a.shape), bits)
+    # initial carry derived from `a` (0*a + 1) so its device-varying type
+    # matches the scan output under shard_map (scan-vma rule)
+    res0 = jnp.broadcast_to(ONE_M, a.shape) + a * jnp.uint64(0)
+    res, _ = jax.lax.scan(step, res0, bits)
     return res
 
 
@@ -270,10 +273,9 @@ def sgn0(a):
     return from_mont(a)[..., 0] & jnp.uint64(1)
 
 
-def lex_gt_half(a):
-    """y > (p-1)/2 on a Montgomery-form element — the compressed-point sign bit
-    (ZCash serialization convention used by the reference's pubkey/sig bytes)."""
-    canon = from_mont(a)
+def lex_gt_half_canon(canon):
+    """x > (p-1)/2 on a *canonical plain-residue* limb array (MSB-first limb
+    compare). Shared by the G1/G2 compressed-point sign-bit paths."""
     half = jnp.asarray(int_to_limbs((P - 1) // 2))
     gt = jnp.zeros(canon.shape[:-1], dtype=bool)
     decided = jnp.zeros(canon.shape[:-1], dtype=bool)
@@ -282,3 +284,9 @@ def lex_gt_half(a):
         gt = jnp.where(~decided & (ai > hi), True, gt)
         decided = decided | (ai != hi)
     return gt
+
+
+def lex_gt_half(a):
+    """y > (p-1)/2 on a Montgomery-form element — the compressed-point sign bit
+    (ZCash serialization convention used by the reference's pubkey/sig bytes)."""
+    return lex_gt_half_canon(from_mont(a))

@@ -109,18 +109,12 @@ def on_curve(p):
 
 def lex_sign(y):
     """ZCash G2 sign bit: c1 > (p-1)/2 if c1 != 0 else c0 > (p-1)/2.
-    One from_mont canonicalization; the half-comparison runs on raw limbs."""
+    One from_mont canonicalization; the comparator is shared with G1."""
     c = fq.from_mont(y)
     c0, c1 = c[..., 0, :], c[..., 1, :]
-    half = jnp.asarray(fq.int_to_limbs((P - 1) // 2))
-    def gt_half(a):
-        gt = jnp.zeros(a.shape[:-1], dtype=bool)
-        decided = jnp.zeros(a.shape[:-1], dtype=bool)
-        for i in range(fq.NLIMBS - 1, -1, -1):
-            gt = jnp.where(~decided & (a[..., i] > half[i]), True, gt)
-            decided = decided | (a[..., i] != half[i])
-        return gt
-    return jnp.where(fq.is_zero(c1), gt_half(c0), gt_half(c1))
+    return jnp.where(
+        fq.is_zero(c1), fq.lex_gt_half_canon(c0), fq.lex_gt_half_canon(c1)
+    )
 
 
 def decompress(x_mont, s_flag):

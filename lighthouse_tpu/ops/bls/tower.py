@@ -182,7 +182,8 @@ def fq2_pow_fixed(a, e: int):
     bits = jnp.asarray(
         [(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=jnp.uint64
     )
-    o = one(2, a.shape[:-2])
+    # varying-safe initial carry (see fq.pow_fixed_scan)
+    o = one(2, a.shape[:-2]) + a * jnp.uint64(0)
 
     def step(res, bit):
         res = fq2_sqr(res)

@@ -1,0 +1,31 @@
+"""State advance helpers (state_advance.rs:28,61).
+
+``complete_state_advance`` hashes every intermediate state (valid roots);
+``partial_state_advance`` skips hashing for speed by writing a placeholder
+root, valid only when the final state will never be hashed across the skipped
+range (the attestation-shuffling use case).
+"""
+
+from __future__ import annotations
+
+from ..types.spec import ChainSpec
+from .per_slot import per_slot_processing
+
+
+def complete_state_advance(spec: ChainSpec, state, target_slot: int) -> None:
+    if state.slot > target_slot:
+        raise ValueError("state ahead of target")
+    while state.slot < target_slot:
+        per_slot_processing(spec, state)
+
+
+def partial_state_advance(spec: ChainSpec, state, target_slot: int) -> None:
+    if state.slot > target_slot:
+        raise ValueError("state ahead of target")
+    first = True
+    while state.slot < target_slot:
+        # Only the first slot's root must be real (it may already be wanted by
+        # the caller); subsequent roots are placeholders.
+        root = None if first else b"\x00" * 32
+        per_slot_processing(spec, state, state_root=root)
+        first = False

@@ -1,0 +1,8 @@
+"""Storage: column KV abstraction, MemoryStore, hot/cold DB.
+
+Twin of ``beacon_node/store``: ``KeyValueStore`` trait + ``MemoryStore`` +
+``HotColdDB`` split (``hot_cold_store.rs:51-81``).
+"""
+
+from .kv import DBColumn, KeyValueStore, MemoryStore, LevelStore
+from .hot_cold import HotColdDB, StoreConfig

@@ -1,0 +1,125 @@
+"""Hot/cold split database (hot_cold_store.rs:51-81).
+
+Hot DB: recent states + all blocks since the split. Cold DB: finalized
+history — full state snapshots every ``slots_per_restore_point`` with
+zlib-compressed SSZ diff-bases in between (the hdiff layer will upgrade this
+to hierarchical binary diffs). States are keyed by state_root; block/state
+summaries let iterators walk ancestor chains without loading full states.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+
+from .kv import DBColumn, KeyValueStore, MemoryStore
+
+
+@dataclass
+class StoreConfig:
+    slots_per_restore_point: int = 32
+    compression_level: int = 1
+
+
+@dataclass
+class Split:
+    """Hot/cold boundary (finalization watermark)."""
+
+    slot: int = 0
+    state_root: bytes = b"\x00" * 32
+
+
+class HotColdDB:
+    """Stores SSZ-encoded blocks/states; callers own (de)serialization of
+    typed containers — the chain layer passes classes per fork."""
+
+    def __init__(
+        self,
+        hot: KeyValueStore | None = None,
+        cold: KeyValueStore | None = None,
+        config: StoreConfig | None = None,
+    ):
+        self.hot = hot or MemoryStore()
+        self.cold = cold or MemoryStore()
+        self.config = config or StoreConfig()
+        self.split = Split()
+
+    # -- blocks -----------------------------------------------------------------
+
+    def put_block(self, block_root: bytes, signed_block_ssz: bytes) -> None:
+        self.hot.put(DBColumn.BeaconBlock, block_root, signed_block_ssz)
+
+    def get_block(self, block_root: bytes) -> bytes | None:
+        return self.hot.get(DBColumn.BeaconBlock, block_root)
+
+    def block_exists(self, block_root: bytes) -> bool:
+        return self.hot.exists(DBColumn.BeaconBlock, block_root)
+
+    def delete_block(self, block_root: bytes) -> None:
+        self.hot.delete(DBColumn.BeaconBlock, block_root)
+
+    # -- hot states -------------------------------------------------------------
+
+    def put_state(self, state_root: bytes, state_ssz: bytes, slot: int) -> None:
+        self.hot.put(DBColumn.BeaconState, state_root, state_ssz)
+        self.hot.put(
+            DBColumn.BeaconStateSummary,
+            state_root,
+            slot.to_bytes(8, "little"),
+        )
+
+    def get_state(self, state_root: bytes) -> bytes | None:
+        s = self.hot.get(DBColumn.BeaconState, state_root)
+        if s is not None:
+            return s
+        return self.load_cold_state(state_root)
+
+    def state_slot(self, state_root: bytes) -> int | None:
+        b = self.hot.get(DBColumn.BeaconStateSummary, state_root)
+        return int.from_bytes(b, "little") if b else None
+
+    def delete_state(self, state_root: bytes) -> None:
+        self.hot.delete(DBColumn.BeaconState, state_root)
+        self.hot.delete(DBColumn.BeaconStateSummary, state_root)
+
+    # -- cold states (freezer) ----------------------------------------------------
+
+    def migrate_to_cold(self, state_root: bytes, slot: int) -> None:
+        """Move a finalized state hot -> cold. Snapshot at restore points,
+        compressed full-state otherwise (diff chain upgrade pending)."""
+        ssz = self.hot.get(DBColumn.BeaconState, state_root)
+        if ssz is None:
+            return
+        compressed = zlib.compress(ssz, self.config.compression_level)
+        col = (
+            DBColumn.ColdState
+            if slot % self.config.slots_per_restore_point == 0
+            else DBColumn.ColdStateDiff
+        )
+        self.cold.put(col, state_root, compressed)
+        self.cold.put(
+            DBColumn.BeaconStateSummary, slot.to_bytes(8, "little"), state_root
+        )
+        self.delete_state(state_root)
+        if slot > self.split.slot:
+            self.split = Split(slot=slot, state_root=state_root)
+
+    def load_cold_state(self, state_root: bytes) -> bytes | None:
+        for col in (DBColumn.ColdState, DBColumn.ColdStateDiff):
+            c = self.cold.get(col, state_root)
+            if c is not None:
+                return zlib.decompress(c)
+        return None
+
+    def cold_state_root_at_slot(self, slot: int) -> bytes | None:
+        return self.cold.get(
+            DBColumn.BeaconStateSummary, slot.to_bytes(8, "little")
+        )
+
+    # -- metadata ----------------------------------------------------------------
+
+    def put_meta(self, key: bytes, value: bytes) -> None:
+        self.hot.put(DBColumn.Metadata, key, value)
+
+    def get_meta(self, key: bytes) -> bytes | None:
+        return self.hot.get(DBColumn.Metadata, key)

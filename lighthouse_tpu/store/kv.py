@@ -1,0 +1,200 @@
+"""Key-value store abstraction (store/src/lib.rs KeyValueStore trait).
+
+Columns mirror the reference's ``DBColumn`` byte prefixes; ``MemoryStore`` is
+the test/in-process backend (``memory_store.rs``), ``LevelStore`` a
+file-backed backend over a sorted on-disk log + in-memory index (standing in
+for LevelDB until the C++ engine lands — same interface, durable)."""
+
+from __future__ import annotations
+
+import enum
+import os
+import struct
+import threading
+
+
+class DBColumn(enum.Enum):
+    BeaconBlock = b"blk"
+    BeaconState = b"ste"
+    BeaconStateSummary = b"ssy"
+    BeaconBlobs = b"blb"
+    ForkChoice = b"frk"
+    PubkeyCache = b"pkc"
+    BeaconChain = b"bch"
+    OpPool = b"opo"
+    Eth1Cache = b"etc"
+    HotDiff = b"hdf"
+    ColdState = b"cst"
+    ColdStateDiff = b"cdf"
+    Metadata = b"met"
+
+
+class KeyValueStore:
+    def get(self, column: DBColumn, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def put(self, column: DBColumn, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, column: DBColumn, key: bytes) -> None:
+        raise NotImplementedError
+
+    def exists(self, column: DBColumn, key: bytes) -> bool:
+        return self.get(column, key) is not None
+
+    def iter_column(self, column: DBColumn):
+        raise NotImplementedError
+
+    def do_atomically(self, ops: list) -> None:
+        """ops: list of ("put", col, key, val) | ("delete", col, key)."""
+        for op in ops:
+            if op[0] == "put":
+                self.put(op[1], op[2], op[3])
+            else:
+                self.delete(op[1], op[2])
+
+    def compact(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(KeyValueStore):
+    """Thread-safe dict store (memory_store.rs)."""
+
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def _k(column: DBColumn, key: bytes) -> bytes:
+        return column.value + b"/" + key
+
+    def get(self, column, key):
+        with self._lock:
+            return self._data.get(self._k(column, key))
+
+    def put(self, column, key, value):
+        with self._lock:
+            self._data[self._k(column, key)] = bytes(value)
+
+    def delete(self, column, key):
+        with self._lock:
+            self._data.pop(self._k(column, key), None)
+
+    def iter_column(self, column):
+        prefix = column.value + b"/"
+        with self._lock:
+            items = [
+                (k[len(prefix):], v)
+                for k, v in self._data.items()
+                if k.startswith(prefix)
+            ]
+        return iter(sorted(items))
+
+    def do_atomically(self, ops):
+        with self._lock:
+            super().do_atomically(ops)
+
+    def __len__(self):
+        return len(self._data)
+
+
+class LevelStore(KeyValueStore):
+    """Durable append-log store with in-memory index and periodic compaction.
+
+    File format: sequence of records ``[u8 op][u32 klen][u32 vlen][key][val]``.
+    On open the log is replayed; ``compact`` rewrites only live records. Plays
+    the role of ``leveldb_store.rs`` until the native engine arrives."""
+
+    _PUT, _DEL = 1, 2
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._index: dict[bytes, tuple[int, int]] = {}  # key -> (offset, vlen)
+        self._lock = threading.RLock()
+        self._fh = open(path, "a+b")
+        self._replay()
+
+    def _replay(self):
+        self._fh.seek(0)
+        data = self._fh.read()
+        pos = 0
+        while pos + 9 <= len(data):
+            op, klen, vlen = struct.unpack_from("<BII", data, pos)
+            pos += 9
+            if pos + klen + vlen > len(data):
+                break  # truncated tail: discard
+            key = data[pos : pos + klen]
+            pos += klen
+            if op == self._PUT:
+                self._index[key] = (pos, vlen)
+            else:
+                self._index.pop(key, None)
+            pos += vlen
+
+    def _append(self, op: int, key: bytes, value: bytes = b"") -> int:
+        self._fh.seek(0, os.SEEK_END)
+        start = self._fh.tell()
+        self._fh.write(struct.pack("<BII", op, len(key), len(value)))
+        self._fh.write(key)
+        voff = start + 9 + len(key)
+        self._fh.write(value)
+        self._fh.flush()
+        return voff
+
+    @staticmethod
+    def _k(column: DBColumn, key: bytes) -> bytes:
+        return column.value + b"/" + key
+
+    def get(self, column, key):
+        k = self._k(column, key)
+        with self._lock:
+            loc = self._index.get(k)
+            if loc is None:
+                return None
+            off, vlen = loc
+            self._fh.seek(off)
+            return self._fh.read(vlen)
+
+    def put(self, column, key, value):
+        k = self._k(column, key)
+        with self._lock:
+            voff = self._append(self._PUT, k, bytes(value))
+            self._index[k] = (voff, len(value))
+
+    def delete(self, column, key):
+        k = self._k(column, key)
+        with self._lock:
+            if k in self._index:
+                self._append(self._DEL, k)
+                self._index.pop(k, None)
+
+    def iter_column(self, column):
+        prefix = column.value + b"/"
+        with self._lock:
+            keys = sorted(k for k in self._index if k.startswith(prefix))
+            return iter([(k[len(prefix):], self.get(column, k[len(prefix):])) for k in keys])
+
+    def compact(self):
+        with self._lock:
+            tmp = self.path + ".compact"
+            with open(tmp, "wb") as out:
+                new_index = {}
+                for k, (off, vlen) in sorted(self._index.items()):
+                    self._fh.seek(off)
+                    v = self._fh.read(vlen)
+                    start = out.tell()
+                    out.write(struct.pack("<BII", self._PUT, len(k), len(v)))
+                    out.write(k)
+                    out.write(v)
+                    new_index[k] = (start + 9 + len(k), len(v))
+            self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a+b")
+            self._index = new_index
+
+    def close(self):
+        self._fh.close()

@@ -1,0 +1,3 @@
+"""In-process test harness (test_utils.rs twin)."""
+
+from .harness import StateHarness

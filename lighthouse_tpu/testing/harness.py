@@ -1,0 +1,190 @@
+"""State-transition harness: deterministic validators producing real blocks.
+
+The state-level core of the reference's ``BeaconChainHarness``
+(``/root/reference/beacon_node/beacon_chain/src/test_utils.rs:645``):
+interop keypairs (``:367``), block production with valid proposer/randao
+signatures, committee-complete attestation production, and slot advancement —
+everything needed to drive ``per_block_processing`` end-to-end without a
+network. The chain layer (stores, fork choice) wraps this later.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.bls_oracle import ciphersuite as cs
+from ..ops.bls_oracle import curves as oc
+from ..types.containers import Checkpoint, for_preset
+from ..types.helpers import compute_signing_root, get_domain
+from ..types.spec import ChainSpec
+from ..ssz import uint64
+from ..state_transition import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_block_root_at_slot,
+    get_committee_count_per_slot,
+    get_current_epoch,
+    per_block_processing,
+    process_slots,
+    BlockSignatureStrategy,
+)
+from ..state_transition.genesis import interop_genesis_state, interop_secret_keys
+from ..state_transition.per_block import ConsensusContext
+
+
+class StateHarness:
+    def __init__(self, spec: ChainSpec, n_validators: int, genesis_time: int = 0):
+        self.spec = spec
+        self.ns = for_preset(spec.preset.name)
+        self.sks = interop_secret_keys(n_validators)
+        self.state = interop_genesis_state(spec, n_validators, genesis_time)
+
+    # -- signing helpers ----------------------------------------------------------
+
+    def _sign(self, sk_index: int, signing_root: bytes) -> bytes:
+        sig = cs.sign(self.sks[sk_index], signing_root)
+        return oc.g2_compress(sig)
+
+    def randao_reveal(self, state, proposer: int, epoch: int) -> bytes:
+        domain = get_domain(self.spec, state, self.spec.DOMAIN_RANDAO, epoch=epoch)
+        from ..types.containers import SigningData
+
+        root = SigningData(
+            object_root=uint64.hash_tree_root(epoch), domain=domain
+        ).tree_root()
+        return self._sign(proposer, root)
+
+    # -- attestations -------------------------------------------------------------
+
+    def attestations_for_slot(self, state, slot: int, head_root: bytes) -> list:
+        """One fully-aggregated attestation per committee at ``slot``."""
+        spec = self.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        target_root = (
+            head_root
+            if slot == spec.start_slot(epoch)
+            else get_block_root_at_slot(spec, state, spec.start_slot(epoch))
+        )
+        atts = []
+        domain = get_domain(spec, state, spec.DOMAIN_BEACON_ATTESTER, epoch=epoch)
+        n_comm = get_committee_count_per_slot(spec, state, epoch)
+        from ..types.containers import AttestationData
+
+        for index in range(n_comm):
+            committee = get_beacon_committee(spec, state, slot, index)
+            data = AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=target_root),
+            )
+            root = compute_signing_root(data, domain)
+            sig = None
+            for v in committee:
+                s = cs.sign(self.sks[int(v)], root)
+                sig = oc.g2_add(sig, s)
+            atts.append(
+                self.ns.Attestation(
+                    aggregation_bits=np.ones(committee.size, dtype=bool),
+                    data=data,
+                    signature=oc.g2_compress(sig),
+                )
+            )
+        return atts
+
+    # -- blocks -------------------------------------------------------------------
+
+    def produce_block(self, slot: int, attestations=None):
+        """Produce a signed block on top of the current state at ``slot``."""
+        spec = self.spec
+        state = self.state.copy()
+        if state.slot < slot:
+            process_slots(spec, state, slot)
+        proposer = get_beacon_proposer_index(spec, state)
+        epoch = get_current_epoch(spec, state)
+        parent_root = state.latest_block_header.tree_root()
+
+        fork = spec.fork_name_at_epoch(epoch)
+        body_cls = self.ns.body_types[fork]
+        block_cls = self.ns.block_types[fork]
+        body = body_cls(
+            randao_reveal=self.randao_reveal(state, proposer, epoch),
+            eth1_data=state.eth1_data,
+            attestations=attestations or [],
+        )
+        if fork != "phase0":
+            body.sync_aggregate = self._sync_aggregate(state, slot)
+        inner_cls = dict(block_cls.FIELDS)["message"]
+        block = inner_cls(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=parent_root,
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        # compute post-state root with signatures skipped
+        trial = state.copy()
+        signed_trial = block_cls(message=block, signature=b"\x00" * 96)
+        per_block_processing(
+            spec, trial, signed_trial,
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            verify_block_root=False,
+        )
+        block.state_root = trial.tree_root()
+        # proposer signature
+        domain = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=epoch)
+        sig = self._sign(proposer, compute_signing_root(block, domain))
+        return block_cls(message=block, signature=sig)
+
+    def _sync_aggregate(self, state, slot: int):
+        spec = self.spec
+        prev_slot = max(slot, 1) - 1
+        root = get_block_root_at_slot(spec, state, prev_slot)
+        domain = get_domain(
+            spec, state, spec.DOMAIN_SYNC_COMMITTEE,
+            epoch=spec.compute_epoch_at_slot(prev_slot),
+        )
+        from ..types.containers import SigningData
+
+        signing_root = SigningData(object_root=root, domain=domain).tree_root()
+        pk_to_idx = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+        sig = None
+        bits = []
+        for pk in state.current_sync_committee.pubkeys:
+            idx = pk_to_idx[bytes(pk)]
+            bits.append(True)
+            sig = oc.g2_add(sig, cs.sign(self.sks[idx], signing_root))
+        return self.ns.SyncAggregate(
+            sync_committee_bits=np.array(bits, dtype=bool),
+            sync_committee_signature=oc.g2_compress(sig),
+        )
+
+    def apply_block(self, signed_block, strategy=BlockSignatureStrategy.VERIFY_BULK):
+        """Advance self.state through the block's slot and apply it."""
+        spec = self.spec
+        if self.state.slot < signed_block.message.slot:
+            process_slots(spec, self.state, signed_block.message.slot)
+        ctxt = per_block_processing(spec, self.state, signed_block, strategy=strategy)
+        return ctxt
+
+    def extend_chain(self, n_blocks: int, with_attestations: bool = True):
+        """Produce + apply n blocks, attesting to each head (test_utils.rs
+        extend_chain shape)."""
+        for _ in range(n_blocks):
+            slot = self.state.slot + 1
+            atts = []
+            if with_attestations and slot > 1:
+                # attest to the previous slot's head from the pre-state; the
+                # true block root needs the header's state_root filled in
+                prev = self.state
+                hdr = prev.latest_block_header.copy()
+                if bytes(hdr.state_root) == b"\x00" * 32:
+                    hdr.state_root = prev.tree_root()
+                head_root = hdr.tree_root()
+                att_slot = prev.slot
+                if att_slot + self.spec.min_attestation_inclusion_delay <= slot:
+                    atts = self.attestations_for_slot(prev, att_slot, head_root)
+            block = self.produce_block(slot, attestations=atts)
+            self.apply_block(block)
+        return self.state

@@ -1,0 +1,315 @@
+"""Consensus containers (phase0 + altair core; later forks extend here).
+
+Per-preset container classes are generated once by ``for_preset`` — the Python
+analog of the reference's ``EthSpec``-monomorphized types
+(``consensus/types/src/*.rs``; fork variants via superstruct become subclass
+chains here, e.g. ``BeaconStateAltair(BeaconStatePhase0)`` with extended
+FIELDS). Field names and SSZ shapes match the consensus spec exactly so EF
+ssz_static vectors apply unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from types import SimpleNamespace
+
+from ..ssz import (
+    Bitlist, Bitvector, ByteList, ByteVector, Container, List, Vector,
+    boolean, uint8, uint64, uint256,
+)
+from .spec import Preset, PRESETS
+
+# -- aliases (fixed across presets) ----------------------------------------------
+
+Root = ByteVector(32)
+Hash32 = ByteVector(32)
+Bytes4 = ByteVector(4)
+Bytes20 = ByteVector(20)
+BLSPubkey = ByteVector(48)
+BLSSignature = ByteVector(96)
+KZGCommitment = ByteVector(48)
+
+Slot = uint64
+Epoch = uint64
+Gwei = uint64
+ValidatorIndex = uint64
+CommitteeIndex = uint64
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+JUSTIFICATION_BITS_LENGTH = 4
+
+
+class Fork(Container):
+    FIELDS = [
+        ("previous_version", Bytes4),
+        ("current_version", Bytes4),
+        ("epoch", Epoch),
+    ]
+
+
+class ForkData(Container):
+    FIELDS = [("current_version", Bytes4), ("genesis_validators_root", Root)]
+
+
+class Checkpoint(Container):
+    FIELDS = [("epoch", Epoch), ("root", Root)]
+
+
+class SigningData(Container):
+    FIELDS = [("object_root", Root), ("domain", ByteVector(32))]
+
+
+class Validator(Container):
+    FIELDS = [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", ByteVector(32)),
+        ("effective_balance", Gwei),
+        ("slashed", boolean),
+        ("activation_eligibility_epoch", Epoch),
+        ("activation_epoch", Epoch),
+        ("exit_epoch", Epoch),
+        ("withdrawable_epoch", Epoch),
+    ]
+
+
+class AttestationData(Container):
+    FIELDS = [
+        ("slot", Slot),
+        ("index", CommitteeIndex),
+        ("beacon_block_root", Root),
+        ("source", Checkpoint),
+        ("target", Checkpoint),
+    ]
+
+
+class Eth1Data(Container):
+    FIELDS = [
+        ("deposit_root", Root),
+        ("deposit_count", uint64),
+        ("block_hash", Hash32),
+    ]
+
+
+class DepositMessage(Container):
+    FIELDS = [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", ByteVector(32)),
+        ("amount", Gwei),
+    ]
+
+
+class DepositData(Container):
+    FIELDS = [
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", ByteVector(32)),
+        ("amount", Gwei),
+        ("signature", BLSSignature),
+    ]
+
+
+class BeaconBlockHeader(Container):
+    FIELDS = [
+        ("slot", Slot),
+        ("proposer_index", ValidatorIndex),
+        ("parent_root", Root),
+        ("state_root", Root),
+        ("body_root", Root),
+    ]
+
+
+class SignedBeaconBlockHeader(Container):
+    FIELDS = [("message", BeaconBlockHeader), ("signature", BLSSignature)]
+
+
+class ProposerSlashing(Container):
+    FIELDS = [
+        ("signed_header_1", SignedBeaconBlockHeader),
+        ("signed_header_2", SignedBeaconBlockHeader),
+    ]
+
+
+class Deposit(Container):
+    FIELDS = [
+        ("proof", Vector(ByteVector(32), DEPOSIT_CONTRACT_TREE_DEPTH + 1)),
+        ("data", DepositData),
+    ]
+
+
+class VoluntaryExit(Container):
+    FIELDS = [("epoch", Epoch), ("validator_index", ValidatorIndex)]
+
+
+class SignedVoluntaryExit(Container):
+    FIELDS = [("message", VoluntaryExit), ("signature", BLSSignature)]
+
+
+# -- preset-parameterized containers ----------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def for_preset(preset_name: str) -> SimpleNamespace:
+    p: Preset = PRESETS[preset_name]
+
+    class IndexedAttestation(Container):
+        FIELDS = [
+            ("attesting_indices", List(uint64, p.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("signature", BLSSignature),
+        ]
+
+    class Attestation(Container):
+        FIELDS = [
+            ("aggregation_bits", Bitlist(p.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("signature", BLSSignature),
+        ]
+
+    class PendingAttestation(Container):
+        FIELDS = [
+            ("aggregation_bits", Bitlist(p.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("inclusion_delay", Slot),
+            ("proposer_index", ValidatorIndex),
+        ]
+
+    class AttesterSlashing(Container):
+        FIELDS = [
+            ("attestation_1", IndexedAttestation),
+            ("attestation_2", IndexedAttestation),
+        ]
+
+    class HistoricalBatch(Container):
+        FIELDS = [
+            ("block_roots", Vector(Root, p.SLOTS_PER_HISTORICAL_ROOT)),
+            ("state_roots", Vector(Root, p.SLOTS_PER_HISTORICAL_ROOT)),
+        ]
+
+    class SyncCommittee(Container):
+        FIELDS = [
+            ("pubkeys", Vector(BLSPubkey, p.SYNC_COMMITTEE_SIZE)),
+            ("aggregate_pubkey", BLSPubkey),
+        ]
+
+    class SyncAggregate(Container):
+        FIELDS = [
+            ("sync_committee_bits", Bitvector(p.SYNC_COMMITTEE_SIZE)),
+            ("sync_committee_signature", BLSSignature),
+        ]
+
+    class BeaconBlockBody(Container):
+        FIELDS = [
+            ("randao_reveal", BLSSignature),
+            ("eth1_data", Eth1Data),
+            ("graffiti", ByteVector(32)),
+            ("proposer_slashings", List(ProposerSlashing, p.MAX_PROPOSER_SLASHINGS)),
+            ("attester_slashings", List(AttesterSlashing, p.MAX_ATTESTER_SLASHINGS)),
+            ("attestations", List(Attestation, p.MAX_ATTESTATIONS)),
+            ("deposits", List(Deposit, p.MAX_DEPOSITS)),
+            ("voluntary_exits", List(SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS)),
+        ]
+
+    class BeaconBlock(Container):
+        FIELDS = [
+            ("slot", Slot),
+            ("proposer_index", ValidatorIndex),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BeaconBlockBody),
+        ]
+
+    class SignedBeaconBlock(Container):
+        FIELDS = [("message", BeaconBlock), ("signature", BLSSignature)]
+
+    class BeaconState(Container):
+        FIELDS = [
+            ("genesis_time", uint64),
+            ("genesis_validators_root", Root),
+            ("slot", Slot),
+            ("fork", Fork),
+            ("latest_block_header", BeaconBlockHeader),
+            ("block_roots", Vector(Root, p.SLOTS_PER_HISTORICAL_ROOT)),
+            ("state_roots", Vector(Root, p.SLOTS_PER_HISTORICAL_ROOT)),
+            ("historical_roots", List(Root, p.HISTORICAL_ROOTS_LIMIT)),
+            ("eth1_data", Eth1Data),
+            ("eth1_data_votes", List(Eth1Data, p.slots_per_eth1_voting_period)),
+            ("eth1_deposit_index", uint64),
+            ("validators", List(Validator, p.VALIDATOR_REGISTRY_LIMIT)),
+            ("balances", List(Gwei, p.VALIDATOR_REGISTRY_LIMIT)),
+            ("randao_mixes", Vector(Root, p.EPOCHS_PER_HISTORICAL_VECTOR)),
+            ("slashings", Vector(Gwei, p.EPOCHS_PER_SLASHINGS_VECTOR)),
+            ("previous_epoch_attestations",
+             List(PendingAttestation, p.MAX_ATTESTATIONS * p.SLOTS_PER_EPOCH)),
+            ("current_epoch_attestations",
+             List(PendingAttestation, p.MAX_ATTESTATIONS * p.SLOTS_PER_EPOCH)),
+            ("justification_bits", Bitvector(JUSTIFICATION_BITS_LENGTH)),
+            ("previous_justified_checkpoint", Checkpoint),
+            ("current_justified_checkpoint", Checkpoint),
+            ("finalized_checkpoint", Checkpoint),
+        ]
+
+        fork_name = "phase0"
+
+    # -- altair variants -----------------------------------------------------
+
+    class BeaconBlockBodyAltair(Container):
+        FIELDS = BeaconBlockBody.FIELDS + [("sync_aggregate", SyncAggregate)]
+
+    class BeaconBlockAltair(Container):
+        FIELDS = [
+            ("slot", Slot),
+            ("proposer_index", ValidatorIndex),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BeaconBlockBodyAltair),
+        ]
+
+    class SignedBeaconBlockAltair(Container):
+        FIELDS = [("message", BeaconBlockAltair), ("signature", BLSSignature)]
+
+    class BeaconStateAltair(Container):
+        FIELDS = [
+            f for f in BeaconState.FIELDS
+            if f[0] not in ("previous_epoch_attestations", "current_epoch_attestations")
+        ]
+        # splice participation in place of pending attestations, append the rest
+        _idx = [n for n, _ in FIELDS].index("slashings") + 1
+        FIELDS = (
+            FIELDS[:_idx]
+            + [
+                ("previous_epoch_participation",
+                 List(uint8, p.VALIDATOR_REGISTRY_LIMIT)),
+                ("current_epoch_participation",
+                 List(uint8, p.VALIDATOR_REGISTRY_LIMIT)),
+            ]
+            + FIELDS[_idx:]
+            + [
+                ("inactivity_scores", List(uint64, p.VALIDATOR_REGISTRY_LIMIT)),
+                ("current_sync_committee", SyncCommittee),
+                ("next_sync_committee", SyncCommittee),
+            ]
+        )
+        fork_name = "altair"
+
+    ns = SimpleNamespace(
+        preset=p,
+        IndexedAttestation=IndexedAttestation,
+        Attestation=Attestation,
+        PendingAttestation=PendingAttestation,
+        AttesterSlashing=AttesterSlashing,
+        HistoricalBatch=HistoricalBatch,
+        SyncCommittee=SyncCommittee,
+        SyncAggregate=SyncAggregate,
+        BeaconBlockBody=BeaconBlockBody,
+        BeaconBlock=BeaconBlock,
+        SignedBeaconBlock=SignedBeaconBlock,
+        BeaconState=BeaconState,
+        BeaconBlockBodyAltair=BeaconBlockBodyAltair,
+        BeaconBlockAltair=BeaconBlockAltair,
+        SignedBeaconBlockAltair=SignedBeaconBlockAltair,
+        BeaconStateAltair=BeaconStateAltair,
+        # fork-indexed lookup used by generic code
+        state_types={"phase0": BeaconState, "altair": BeaconStateAltair},
+        block_types={"phase0": SignedBeaconBlock, "altair": SignedBeaconBlockAltair},
+        body_types={"phase0": BeaconBlockBody, "altair": BeaconBlockBodyAltair},
+    )
+    return ns

@@ -1,0 +1,3 @@
+"""Cross-cutting commons (common/* twin): slot clocks, task executor, metrics."""
+
+from .slot_clock import ManualSlotClock, SlotClock, SystemTimeSlotClock

@@ -1,0 +1,163 @@
+"""BeaconChain integration: import pipeline, fork choice, attestation batches.
+
+The in-process-chain tier of the reference's test strategy
+(``beacon_chain/tests/*`` over BeaconChainHarness): MemoryStore + manual slot
+clock + interop keys, no network.
+"""
+
+import numpy as np
+import pytest
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain import BeaconChain, BlockError
+from lighthouse_tpu.state_transition.genesis import interop_genesis_state
+from lighthouse_tpu.testing import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def oracle_backend():
+    bls.set_backend("oracle")
+    yield
+    bls.set_backend("tpu")
+
+
+@pytest.fixture()
+def chain_and_harness():
+    spec = minimal_spec()
+    h = StateHarness(spec, N)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock)
+    return chain, h, clock
+
+
+class TestChain:
+    def test_import_blocks_and_head(self, chain_and_harness):
+        chain, h, clock = chain_and_harness
+        for slot in (1, 2, 3):
+            clock.set_slot(slot)
+            block = h.produce_block(slot)
+            h.apply_block(block)
+            root = chain.process_block(block)
+            assert chain.head.root == root
+        assert chain.head.slot == 3
+
+    def test_future_block_rejected(self, chain_and_harness):
+        chain, h, clock = chain_and_harness
+        block = h.produce_block(2)
+        clock.set_slot(1)
+        with pytest.raises(BlockError):
+            chain.process_block(block)
+
+    def test_invalid_signature_rejected(self, chain_and_harness):
+        chain, h, clock = chain_and_harness
+        clock.set_slot(1)
+        block = h.produce_block(1)
+        bad = type(block)(message=block.message, signature=b"\xab" + bytes(95))
+        with pytest.raises((BlockError, bls.BlsError)):
+            chain.process_block(bad)
+
+    def test_chain_segment_batch(self, chain_and_harness):
+        chain, h, clock = chain_and_harness
+        blocks = []
+        for slot in (1, 2, 3, 4):
+            b = h.produce_block(slot)
+            h.apply_block(b)
+            blocks.append(b)
+        clock.set_slot(4)
+        roots = chain.process_chain_segment(blocks)
+        assert len(roots) == 4
+        assert chain.head.slot == 4
+
+    def test_attestation_batch_with_poison(self, chain_and_harness):
+        chain, h, clock = chain_and_harness
+        clock.set_slot(1)
+        block = h.produce_block(1)
+        h.apply_block(block)
+        root = chain.process_block(block)
+        clock.set_slot(2)
+        atts = h.attestations_for_slot(h.state, 1, root)
+        assert atts
+        # poison a copy of the first attestation
+        bad = type(atts[0])(
+            aggregation_bits=atts[0].aggregation_bits,
+            data=atts[0].data,
+            signature=b"\xaa" + bytes(95),
+        )
+        results = chain.verify_unaggregated_attestations(atts + [bad])
+        oks = [r for _, r in results if not isinstance(r, Exception)]
+        errs = [r for _, r in results if isinstance(r, Exception)]
+        assert len(oks) == len(atts)
+        assert len(errs) == 1
+
+    def test_fork_resolution_by_votes(self, chain_and_harness):
+        """Two competing blocks at the same slot; attestations decide."""
+        chain, h, clock = chain_and_harness
+        clock.set_slot(1)
+        b1 = h.produce_block(1)
+        # competing block: different graffiti via produce on a fresh harness copy
+        h2 = StateHarness(h.spec, N)
+        blk2, _ = None, None
+        # vary the block by including no attestations but a different state:
+        # simplest distinct block: produce at slot 1 then mutate graffiti+resign
+        import copy
+
+        msg2 = b1.message.copy()
+        msg2.body = msg2.body.copy()
+        msg2.body.graffiti = b"\x01" * 32
+        # recompute state root + signature
+        from lighthouse_tpu.state_transition import (
+            BlockSignatureStrategy, per_block_processing, process_slots,
+        )
+        from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+        st = h2.state.copy()
+        process_slots(h.spec, st, 1)
+        trial = st.copy()
+        per_block_processing(
+            h.spec, trial, type(b1)(message=msg2, signature=b"\x00" * 96),
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            verify_block_root=False,
+        )
+        msg2.state_root = trial.tree_root()
+        domain = get_domain(h.spec, st, h.spec.DOMAIN_BEACON_PROPOSER, epoch=0)
+        sig = h2._sign(msg2.proposer_index, compute_signing_root(msg2, domain))
+        b2 = type(b1)(message=msg2, signature=sig)
+
+        r1 = chain.process_block(b1)
+        r2 = chain.process_block(b2, is_first_block_in_slot=False)
+        assert r1 != r2
+        # attest in favor of b2 (the non-head fork, whichever head is now)
+        h.apply_block(b1)
+        clock.set_slot(2)
+        target = r2 if chain.head.root == r1 else r1
+        st_t = chain._states[target]
+        atts = []
+        from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+        from lighthouse_tpu.ops.bls_oracle import ciphersuite as cs
+        from lighthouse_tpu.ops.bls_oracle import curves as oc
+        from lighthouse_tpu.state_transition import get_beacon_committee
+
+        committee = get_beacon_committee(h.spec, st_t, 1, 0)
+        data = AttestationData(
+            slot=1, index=0, beacon_block_root=target,
+            source=st_t.current_justified_checkpoint,
+            target=Checkpoint(epoch=0, root=chain.genesis_block_root),
+        )
+        domain = get_domain(h.spec, st_t, h.spec.DOMAIN_BEACON_ATTESTER, epoch=0)
+        root = compute_signing_root(data, domain)
+        sig = None
+        for v in committee:
+            sig = oc.g2_add(sig, cs.sign(h.sks[int(v)], root))
+        att = h.ns.Attestation(
+            aggregation_bits=np.ones(committee.size, dtype=bool),
+            data=data, signature=oc.g2_compress(sig),
+        )
+        results = chain.verify_unaggregated_attestations([att])
+        assert not isinstance(results[0][1], Exception)
+        clock.set_slot(3)
+        assert chain.recompute_head() == target

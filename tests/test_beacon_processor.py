@@ -1,0 +1,117 @@
+"""Scheduler tests: priority order, batch forming, back-pressure, reprocess."""
+
+import pytest
+
+from lighthouse_tpu.beacon_processor import (
+    BeaconProcessor, BeaconProcessorConfig, QueueLengths, ReprocessQueue,
+    Work, WorkType,
+)
+
+
+def _proc(**kw):
+    cfg = BeaconProcessorConfig(**kw)
+    return BeaconProcessor(cfg, synchronous=False)
+
+
+class TestScheduler:
+    def test_priority_order(self):
+        p = _proc()
+        p.shutdown()  # manual drain
+        order = []
+        mk = lambda t, tag: Work(t, tag, process_individual=lambda x: order.append(x))
+        p.submit(mk(WorkType.GossipAttestation, "att"))
+        p.submit(mk(WorkType.GossipBlock, "block"))
+        p.submit(mk(WorkType.Status, "status"))
+        p.run_until_idle()
+        assert order == ["block", "att", "status"]
+
+    def test_batch_forming(self):
+        p = _proc(max_batch_size=3)
+        p.shutdown()
+        batches = []
+        singles = []
+        for i in range(7):
+            p.submit(
+                Work(
+                    WorkType.GossipAttestation,
+                    i,
+                    process_individual=singles.append,
+                    process_batch=batches.append,
+                )
+            )
+        p.run_until_idle()
+        # LIFO queue: freshest first; batches of <=3
+        assert sum(len(b) for b in batches) + len(singles) == 7
+        assert all(len(b) <= 3 for b in batches)
+        assert p.batches_formed >= 2
+        assert p.processed[WorkType.GossipAttestation] == 7
+
+    def test_lifo_freshest_first(self):
+        p = _proc(max_batch_size=2)
+        p.shutdown()
+        seen = []
+        for i in range(4):
+            p.submit(
+                Work(
+                    WorkType.GossipAttestation, i,
+                    process_batch=lambda xs: seen.extend(xs),
+                )
+            )
+        p.run_until_idle()
+        assert seen[0] == 3  # newest attestation dispatched first
+
+    def test_backpressure_drops(self):
+        ql = QueueLengths(overrides={WorkType.GossipAttestation: 2})
+        p = BeaconProcessor(
+            BeaconProcessorConfig(queue_lengths=ql), synchronous=False
+        )
+        p.shutdown()
+        ok = [p.submit(Work(WorkType.GossipAttestation, i)) for i in range(5)]
+        assert ok == [True, True, False, False, False]
+        assert p.dropped[WorkType.GossipAttestation] == 3
+
+    def test_queue_lengths_scale_with_validators(self):
+        ql = QueueLengths.from_active_validators(1_000_000)
+        assert ql.limit(WorkType.GossipAttestation) == 1_100_000
+        assert ql.limit(WorkType.GossipBlock) == 16384
+
+    def test_threaded_workers_drain(self):
+        import threading
+
+        p = _proc(max_workers=2)
+        done = threading.Event()
+        count = [0]
+        lock = threading.Lock()
+
+        def handle(x):
+            with lock:
+                count[0] += 1
+                if count[0] == 50:
+                    done.set()
+
+        for i in range(50):
+            p.submit(Work(WorkType.Status, i, process_individual=handle))
+        assert done.wait(timeout=5.0)
+        p.shutdown()
+
+
+class TestReprocess:
+    def test_unknown_block_release_and_expiry(self):
+        out = []
+        rq = ReprocessQueue(out.append)
+        rq.queue_unknown_block_work(b"\x01" * 32, "att1", slot=5)
+        rq.queue_unknown_block_work(b"\x02" * 32, "att2", slot=5)
+        assert rq.on_block_imported(b"\x01" * 32) == 1
+        assert out == ["att1"]
+        rq.on_slot(9)  # att2 expires (5 + 2 < 9)
+        assert rq.expired == 1
+        assert rq.on_block_imported(b"\x02" * 32) == 0
+
+    def test_early_block_released_at_slot(self):
+        out = []
+        rq = ReprocessQueue(out.append)
+        rq.queue_early_block(7, "blk")
+        rq.on_slot(6)
+        assert out == []
+        rq.on_slot(7)
+        assert out == ["blk"]

@@ -1,0 +1,158 @@
+"""Fork-choice tests: proto-array weights, LMD votes, boost, invalidation.
+
+Mirrors the scenario style of
+``consensus/proto_array/src/fork_choice_test_definition`` (votes/weights on
+small block trees) without the data files.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.fork_choice import (
+    ExecutionStatus, ForkChoice, ProtoArrayForkChoice,
+)
+from lighthouse_tpu.fork_choice.proto_array import ProtoArrayError
+from lighthouse_tpu.types.spec import minimal_spec
+
+R = lambda i: bytes([i]) * 32
+
+
+def _proto():
+    return ProtoArrayForkChoice(
+        finalized_root=R(0), finalized_slot=0, justified_epoch=0, finalized_epoch=0
+    )
+
+
+def _add(p, i, parent, slot=None, j=0, f=0, **kw):
+    p.on_block(
+        slot=slot if slot is not None else i,
+        root=R(i),
+        parent_root=R(parent),
+        state_root=b"\x00" * 32,
+        target_root=R(0),
+        justified_epoch=j,
+        finalized_epoch=f,
+        **kw,
+    )
+
+
+class TestProtoArray:
+    def test_single_chain_head(self):
+        p = _proto()
+        _add(p, 1, 0)
+        _add(p, 2, 1)
+        head = p.find_head(0, R(0), 0, np.zeros(0, dtype=np.uint64))
+        assert head == R(2)
+
+    def test_votes_pick_heavier_fork(self):
+        p = _proto()
+        _add(p, 1, 0)
+        _add(p, 2, 0)  # fork at genesis
+        balances = np.full(3, 32, dtype=np.uint64)
+        p.process_attestation(0, R(1), 1)
+        p.process_attestation(1, R(2), 1)
+        p.process_attestation(2, R(2), 1)
+        head = p.find_head(0, R(0), 0, balances)
+        assert head == R(2)
+        # votes move: all to 1
+        for v in range(3):
+            p.process_attestation(v, R(1), 2)
+        head = p.find_head(0, R(0), 0, balances)
+        assert head == R(1)
+
+    def test_tie_breaks_by_root(self):
+        p = _proto()
+        _add(p, 1, 0)
+        _add(p, 2, 0)
+        head = p.find_head(0, R(0), 0, np.zeros(0, np.uint64))
+        assert head == R(2)  # higher root wins ties
+
+    def test_equivocating_validator_removed(self):
+        p = _proto()
+        _add(p, 1, 0)
+        _add(p, 2, 0)
+        balances = np.full(2, 32, dtype=np.uint64)
+        p.process_attestation(0, R(1), 1)
+        p.process_attestation(1, R(2), 1)
+        assert p.find_head(0, R(0), 0, balances) == R(2)  # tie -> higher root
+        # validator 1 equivocates: its weight vanishes, head flips to 1
+        assert p.find_head(0, R(0), 0, balances, equivocating_indices={1}) == R(1)
+
+    def test_invalidation_propagates(self):
+        p = _proto()
+        _add(p, 1, 0, execution_status=ExecutionStatus.OPTIMISTIC)
+        _add(p, 2, 1, execution_status=ExecutionStatus.OPTIMISTIC)
+        _add(p, 3, 0, execution_status=ExecutionStatus.VALID)
+        balances = np.full(1, 32, dtype=np.uint64)
+        p.process_attestation(0, R(2), 1)
+        assert p.find_head(0, R(0), 0, balances) == R(2)
+        p.process_execution_payload_invalidation(R(1))
+        head = p.find_head(0, R(0), 0, balances)
+        assert head == R(3)  # invalid branch skipped entirely
+
+    def test_proposer_boost(self):
+        p = _proto()
+        _add(p, 1, 0)
+        _add(p, 2, 0)
+        # one small voter on branch 1; boost = total * 40% / 32 slots
+        # = 128e9 * 0.4 / 32 = 1.6e9 > the 1e9 vote -> branch 2 wins with boost
+        balances = np.array(
+            [10**9] + [42_333_333_333] * 3, dtype=np.uint64
+        )
+        p.process_attestation(0, R(1), 1)
+        assert p.find_head(0, R(0), 0, balances) == R(1)
+        head = p.find_head(
+            0, R(0), 0, balances, proposer_boost_root=R(2), proposer_score_boost=40
+        )
+        assert head == R(2)
+        # boost expires next call (no boost root): back to 1
+        assert p.find_head(0, R(0), 0, balances) == R(1)
+
+    def test_is_descendant_and_prune(self):
+        p = _proto()
+        for i in range(1, 6):
+            _add(p, i, i - 1)
+        assert p.is_descendant(R(2), R(5))
+        assert not p.is_descendant(R(5), R(2))
+        p.maybe_prune(R(3), prune_threshold=2)
+        assert R(1) not in p.indices
+        assert p.is_descendant(R(3), R(5))
+
+
+class TestForkChoiceWrapper:
+    def test_queued_attestation_applies_next_slot(self):
+        spec = minimal_spec()
+        fc = ForkChoice.from_anchor(
+            spec, R(0), 0, (0, R(0)), (0, R(0)), np.full(4, 32, np.uint64)
+        )
+
+        class Blk:
+            slot = 1
+            parent_root = R(0)
+            state_root = b"\x00" * 32
+
+        class St:
+            class current_justified_checkpoint:
+                epoch = 0
+                root = R(0)
+
+            class finalized_checkpoint:
+                epoch = 0
+                root = R(0)
+
+        fc.on_block(1, Blk, R(1), St)
+
+        class IA:
+            attesting_indices = [0, 1]
+
+            class data:
+                slot = 1
+                beacon_block_root = R(1)
+
+                class target:
+                    epoch = 0
+
+        fc.on_attestation(1, IA)  # same slot: queued
+        assert len(fc.queued_attestations) == 1
+        assert fc.get_head(2) == R(1)
+        assert len(fc.queued_attestations) == 0

@@ -1,0 +1,137 @@
+"""Operation pool tests: aggregation, max-cover packing, dedup rules.
+
+Mirrors the inline test module of ``operation_pool/src/lib.rs`` (~1,400 LoC of
+tests in the reference) at smaller scale.
+"""
+
+import numpy as np
+import pytest
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu import bls
+from lighthouse_tpu.op_pool import NaiveAggregationPool, OperationPool, maximum_cover
+from lighthouse_tpu.state_transition import get_beacon_committee, process_slots
+from lighthouse_tpu.testing import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module", autouse=True)
+def oracle_backend():
+    bls.set_backend("oracle")
+    yield
+    bls.set_backend("tpu")
+
+
+class TestMaxCover:
+    def test_greedy_selection(self):
+        w = np.ones(8, dtype=np.uint64)
+        m = lambda *idx: np.isin(np.arange(8), idx)
+        cands = [
+            (m(0, 1), w, "a"),
+            (m(2, 3, 4), w, "b"),
+            (m(0, 1, 2), w, "c"),
+            (m(5), w, "d"),
+        ]
+        # greedy: best first is b or c (3); after c, b covers {3,4}=2, a covers 0
+        out = maximum_cover(cands, 2)
+        assert len(out) == 2
+        assert out[0] in ("b", "c")
+
+    def test_limit_and_empty(self):
+        assert maximum_cover([], 5) == []
+        w = np.ones(4, dtype=np.uint64)
+        cands = [(np.zeros(4, dtype=bool), w, "empty")]
+        assert maximum_cover(cands, 5) == []  # zero-score candidates skipped
+
+
+def _harness_with_attestations():
+    spec = minimal_spec()
+    h = StateHarness(spec, 16)
+    b1 = h.produce_block(1)
+    h.apply_block(b1)
+    hdr = h.state.latest_block_header.copy()
+    if bytes(hdr.state_root) == b"\x00" * 32:
+        hdr.state_root = h.state.tree_root()
+    head_root = hdr.tree_root()
+    atts = h.attestations_for_slot(h.state, 1, head_root)
+    return spec, h, atts
+
+
+class TestPool:
+    def test_insert_and_pack(self):
+        spec, h, atts = _harness_with_attestations()
+        pool = OperationPool(spec, h.ns.Attestation)
+        for a in atts:
+            pool.insert_attestation(a)
+        assert pool.num_attestations() == len(atts)
+        state = h.state.copy()
+        process_slots(spec, state, 2)
+        packed = pool.get_attestations(state)
+        assert len(packed) == len(atts)
+        # packing a block with these attestations must process cleanly
+        block = h.produce_block(2, attestations=packed)
+        h.apply_block(block)
+
+    def test_split_attestations_aggregate_in_pool(self):
+        spec, h, atts = _harness_with_attestations()
+        a = atts[0]
+        bits = np.asarray(a.aggregation_bits, dtype=bool)
+        n = bits.size
+        committee = get_beacon_committee(spec, h.state, 1, 0)
+        # make two half-committee attestations with real signatures
+        from lighthouse_tpu.ops.bls_oracle import ciphersuite as cs
+        from lighthouse_tpu.ops.bls_oracle import curves as oc
+        from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+        domain = get_domain(spec, h.state, spec.DOMAIN_BEACON_ATTESTER, epoch=0)
+        root = compute_signing_root(a.data, domain)
+        halves = []
+        for half in (range(0, n // 2), range(n // 2, n)):
+            hb = np.zeros(n, dtype=bool)
+            sig = None
+            for j in half:
+                hb[j] = True
+                sig = oc.g2_add(sig, cs.sign(h.sks[int(committee[j])], root))
+            halves.append(
+                h.ns.Attestation(
+                    aggregation_bits=hb, data=a.data, signature=oc.g2_compress(sig)
+                )
+            )
+        pool = OperationPool(spec, h.ns.Attestation)
+        pool.insert_attestation(halves[0])
+        pool.insert_attestation(halves[1])
+        assert pool.num_attestations() == 1  # disjoint halves merged
+        state = h.state.copy()
+        process_slots(spec, state, 2)
+        packed = pool.get_attestations(state)
+        assert len(packed) == 1
+        assert np.asarray(packed[0].aggregation_bits).all()
+        block = h.produce_block(2, attestations=packed)
+        h.apply_block(block)  # full verification incl. merged signature
+
+    def test_naive_aggregation_pool(self):
+        spec, h, atts = _harness_with_attestations()
+        a = atts[0]
+        committee = get_beacon_committee(spec, h.state, 1, 0)
+        from lighthouse_tpu.ops.bls_oracle import ciphersuite as cs
+        from lighthouse_tpu.ops.bls_oracle import curves as oc
+        from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+        domain = get_domain(spec, h.state, spec.DOMAIN_BEACON_ATTESTER, epoch=0)
+        root = compute_signing_root(a.data, domain)
+        pool = NaiveAggregationPool(h.ns.Attestation)
+        n = committee.size
+        for j in range(n):
+            bits = np.zeros(n, dtype=bool)
+            bits[j] = True
+            single = h.ns.Attestation(
+                aggregation_bits=bits, data=a.data,
+                signature=oc.g2_compress(cs.sign(h.sks[int(committee[j])], root)),
+            )
+            assert pool.insert(single)
+            assert not pool.insert(single)  # duplicate bit rejected
+        agg = pool.get(a.data)
+        assert np.asarray(agg.aggregation_bits).all()
+        assert bytes(agg.signature) == bytes(a.signature)  # same aggregate
+        pool.prune(10)
+        assert pool.get(a.data) is None

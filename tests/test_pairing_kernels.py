@@ -5,19 +5,19 @@ different unreduced representatives (they differ by Fq2 subfield factors), so
 agreement is asserted *after* final exponentiation — both compute e(P, Q)^3.
 """
 
+import importlib
 import random
-
-import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
 
 import lighthouse_tpu  # noqa: F401
-from lighthouse_tpu.ops.bls import fq, g1, g2, pairing as dp, tower as tw
+from lighthouse_tpu.ops.bls import fq, pairing as dp, tower as tw
 from lighthouse_tpu.ops.bls_oracle import curves as oc, fields as of
-import importlib
 
+# the bls_oracle package __init__ rebinds the name `pairing` to the function,
+# so `from ... import pairing` (and `import ...pairing as op`, which also
+# prefers the package attribute) would grab the function — load the module
 op = importlib.import_module("lighthouse_tpu.ops.bls_oracle.pairing")
 
 rng = random.Random(0xA17)

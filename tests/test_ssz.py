@@ -1,0 +1,174 @@
+"""SSZ layer tests: vectorized sha256 vs hashlib, merkleization vs a hashlib
+reference, (de)serialization round-trips and strictness.
+
+Mirrors the reference's ssz/tree_hash unit tests; the EF ssz_static harness
+plugs in on top of these types later.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ssz import (
+    SSZError, boolean, uint8, uint16, uint64, uint256,
+    Bitlist, Bitvector, ByteList, ByteVector, Container, List, Vector, Union,
+    merkleize_chunks, mix_in_length, sha256_pairs,
+)
+
+
+def h(b):
+    return hashlib.sha256(b).digest()
+
+
+class TestSha256:
+    def test_pairs_match_hashlib(self):
+        rng = np.random.default_rng(7)
+        blocks = rng.integers(0, 256, size=(17, 64), dtype=np.uint8)
+        out = sha256_pairs(blocks)
+        for i in range(17):
+            assert bytes(out[i]) == h(blocks[i].tobytes())
+
+
+class TestMerkle:
+    def test_small_trees(self):
+        c = [h(bytes([i])) for i in range(4)]
+        chunks = np.stack([np.frombuffer(x, dtype=np.uint8) for x in c])
+        assert merkleize_chunks(chunks[:1]) == c[0]
+        assert merkleize_chunks(chunks[:2]) == h(c[0] + c[1])
+        assert merkleize_chunks(chunks[:4]) == h(h(c[0] + c[1]) + h(c[2] + c[3]))
+        # 3 chunks: zero-padded 4th leaf
+        z = b"\x00" * 32
+        assert merkleize_chunks(chunks[:3]) == h(h(c[0] + c[1]) + h(c[2] + z))
+
+    def test_limit_padding(self):
+        z = b"\x00" * 32
+        z1 = h(z + z)
+        chunk = h(b"x")
+        arr = np.frombuffer(chunk, dtype=np.uint8)[None]
+        assert merkleize_chunks(arr, limit=4) == h(h(chunk + z) + z1)
+
+    def test_mix_in_length(self):
+        root = h(b"r")
+        assert mix_in_length(root, 5) == h(root + (5).to_bytes(8, "little") + b"\x00" * 24)
+
+
+class TestBasic:
+    def test_uints(self):
+        assert uint64.encode(0x0102) == b"\x02\x01" + b"\x00" * 6
+        assert uint64.decode(uint64.encode(2**63)) == 2**63
+        assert uint16.decode(b"\x34\x12") == 0x1234
+        assert uint64.hash_tree_root(7) == (7).to_bytes(8, "little") + b"\x00" * 24
+        with pytest.raises(SSZError):
+            uint8.decode(b"\x00\x00")
+
+    def test_bool(self):
+        assert boolean.decode(b"\x01") is True
+        with pytest.raises(SSZError):
+            boolean.decode(b"\x02")
+
+
+class TestComposite:
+    def test_vector_uint(self):
+        v = Vector(uint64, 3)
+        vals = [1, 2, 3]
+        assert v.decode(v.encode(vals)) == vals
+        # htr: one chunk of packed u64s padded
+        packed = b"".join(x.to_bytes(8, "little") for x in vals) + b"\x00" * 8
+        assert v.hash_tree_root(vals) == packed
+
+    def test_list_uint_htr(self):
+        l = List(uint64, 8)  # 8 u64 = 2 chunks limit
+        vals = [5, 6]
+        packed = (5).to_bytes(8, "little") + (6).to_bytes(8, "little") + b"\x00" * 16
+        root = h(packed + b"\x00" * 32)
+        assert l.hash_tree_root(vals) == mix_in_length(root, 2)
+        assert list(l.decode(l.encode(vals))) == vals
+
+    def test_bytes_types(self):
+        bv = ByteVector(32)
+        data = bytes(range(32))
+        assert bv.decode(bv.encode(data)) == data
+        assert bv.hash_tree_root(data) == data  # single chunk
+        bl = ByteList(64)
+        assert bl.hash_tree_root(b"") == mix_in_length(h(b"\x00" * 64), 0)
+
+    def test_bitvector(self):
+        b = Bitvector(10)
+        bits = np.array([1, 0, 1, 1, 0, 0, 0, 0, 1, 1], dtype=bool)
+        enc = b.encode(bits)
+        assert len(enc) == 2
+        assert (b.decode(enc) == bits).all()
+        bad = bytes([enc[0], enc[1] | 0x08])  # padding bit set
+        with pytest.raises(SSZError):
+            b.decode(bad)
+
+    def test_bitlist(self):
+        b = Bitlist(16)
+        bits = np.array([1, 1, 0, 1], dtype=bool)
+        enc = b.encode(bits)
+        assert enc == bytes([0b11011])  # delimiter at position 4
+        assert (b.decode(enc) == bits).all()
+        assert b.encode(np.zeros(0, bool)) == b"\x01"
+        with pytest.raises(SSZError):
+            b.decode(b"")
+        with pytest.raises(SSZError):
+            b.decode(b"\x0b\x00")  # trailing zero byte: missing delimiter
+
+    def test_variable_list_of_bytelists(self):
+        l = List(ByteList(100), 10)
+        vals = [b"ab", b"", b"xyz"]
+        enc = l.encode(vals)
+        assert l.decode(enc) == vals
+
+    def test_union(self):
+        u = Union([None, uint64, ByteVector(4)])
+        assert u.decode(u.encode((0, None))) == (0, None)
+        assert u.decode(u.encode((1, 9))) == (1, 9)
+        assert u.decode(u.encode((2, b"abcd"))) == (2, b"abcd")
+
+
+class Point(Container):
+    FIELDS = [("x", uint64), ("y", uint64)]
+
+
+class Poly(Container):
+    FIELDS = [
+        ("tag", uint64),
+        ("pts", List(uint64, 4)),
+        ("fixed", ByteVector(32)),
+    ]
+
+
+class TestContainer:
+    def test_fixed_roundtrip(self):
+        p = Point(x=3, y=4)
+        enc = p.serialize()
+        assert enc == (3).to_bytes(8, "little") + (4).to_bytes(8, "little")
+        assert Point.decode(enc) == p
+        assert p.tree_root() == h(
+            uint64.hash_tree_root(3) + uint64.hash_tree_root(4)
+        )
+
+    def test_variable_roundtrip(self):
+        v = Poly(tag=7, pts=[1, 2, 3], fixed=b"\xaa" * 32)
+        enc = v.serialize()
+        # fixed part: u64 + offset(4) + 32 bytes
+        assert int.from_bytes(enc[8:12], "little") == 8 + 4 + 32
+        assert Poly.decode(enc) == v
+
+    def test_strictness(self):
+        v = Poly(tag=7, pts=[1], fixed=b"\x00" * 32)
+        enc = bytearray(v.serialize())
+        enc[8] += 1  # corrupt offset
+        with pytest.raises(SSZError):
+            Poly.decode(bytes(enc))
+        with pytest.raises(SSZError):
+            Point.decode(b"\x00" * 17)  # trailing byte
+
+    def test_defaults_and_copy(self):
+        v = Poly()
+        assert v.tag == 0 and v.pts == [] and v.fixed == b"\x00" * 32
+        w = v.copy()
+        w.pts.append(1)
+        assert v.pts == []

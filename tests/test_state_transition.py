@@ -1,0 +1,115 @@
+"""End-to-end state transition tests on the minimal preset.
+
+The harness drives real interop-signed blocks through per_block_processing
+with the oracle BLS backend (fast, CPU) — mirroring the reference's
+BeaconChainHarness tests (beacon_chain/tests). Epoch-boundary runs exercise
+justification/finalization with full participation.
+"""
+
+import numpy as np
+import pytest
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu import bls
+from lighthouse_tpu.state_transition import (
+    BlockProcessingError,
+    BlockSignatureStrategy,
+    get_beacon_proposer_index,
+    get_current_epoch,
+    process_slots,
+    per_block_processing,
+)
+from lighthouse_tpu.state_transition.genesis import interop_genesis_state
+from lighthouse_tpu.testing import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+
+N_VALIDATORS = 32
+
+
+@pytest.fixture(scope="module", autouse=True)
+def oracle_backend():
+    bls.set_backend("oracle")
+    yield
+    bls.set_backend("tpu")
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+class TestGenesis:
+    def test_interop_genesis(self, spec):
+        state = interop_genesis_state(spec, N_VALIDATORS)
+        assert len(state.validators) == N_VALIDATORS
+        assert state.slot == 0
+        assert all(
+            v.activation_epoch == 0 for v in state.validators
+        )
+        root = state.tree_root()
+        assert len(root) == 32
+        # deterministic
+        state2 = interop_genesis_state(spec, N_VALIDATORS)
+        assert state2.tree_root() == root
+
+
+class TestSlots:
+    def test_empty_slot_advance(self, spec):
+        state = interop_genesis_state(spec, N_VALIDATORS)
+        process_slots(spec, state, 3)
+        assert state.slot == 3
+        assert bytes(state.block_roots[1]) != b"\x00" * 32
+
+    def test_epoch_boundary_advance(self, spec):
+        state = interop_genesis_state(spec, N_VALIDATORS)
+        process_slots(spec, state, spec.preset.SLOTS_PER_EPOCH + 1)
+        assert get_current_epoch(spec, state) == 1
+
+
+class TestBlocks:
+    def test_first_block_applies(self, spec):
+        h = StateHarness(spec, N_VALIDATORS)
+        block = h.produce_block(1)
+        h.apply_block(block)
+        assert h.state.slot == 1
+        assert h.state.latest_block_header.slot == 1
+
+    def test_block_with_bad_signature_rejected(self, spec):
+        h = StateHarness(spec, N_VALIDATORS)
+        block = h.produce_block(1)
+        bad = type(block)(message=block.message, signature=b"\xaa" + bytes(95))
+        with pytest.raises((BlockProcessingError, bls.BlsError)):
+            h.apply_block(bad)
+
+    def test_wrong_proposer_rejected(self, spec):
+        h = StateHarness(spec, N_VALIDATORS)
+        block = h.produce_block(1)
+        msg = block.message
+        msg.proposer_index = (msg.proposer_index + 1) % N_VALIDATORS
+        with pytest.raises(BlockProcessingError):
+            h.apply_block(
+                type(block)(message=msg, signature=block.signature),
+                strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            )
+
+    def test_chain_justifies_after_three_epochs(self, spec):
+        # justification first runs at the end of epoch 2 (spec skips
+        # process_justification while current_epoch <= 1)
+        h = StateHarness(spec, N_VALIDATORS)
+        n = 3 * spec.preset.SLOTS_PER_EPOCH + 2
+        h.extend_chain(n)
+        assert h.state.slot == n
+        assert h.state.current_justified_checkpoint.epoch >= 1
+
+    def test_finalization_after_five_epochs(self, spec):
+        h = StateHarness(spec, N_VALIDATORS)
+        n = 5 * spec.preset.SLOTS_PER_EPOCH + 2
+        h.extend_chain(n)
+        assert h.state.finalized_checkpoint.epoch >= 1
+        assert h.state.current_justified_checkpoint.epoch >= 2
+
+    def test_balances_grow_with_rewards(self, spec):
+        h = StateHarness(spec, N_VALIDATORS)
+        h.extend_chain(2 * spec.preset.SLOTS_PER_EPOCH + 2)
+        bal = np.asarray(h.state.balances)
+        assert (bal > spec.max_effective_balance).any()

@@ -1,0 +1,135 @@
+"""Types-layer and shuffle-kernel tests.
+
+Shuffle correctness is pinned by internal consistency (list form vs the
+spec-literal per-index form, forward/backward inversion); EF `shuffling`
+vectors plug into the same functions when present.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ops.shuffle import compute_shuffled_index, shuffle_list
+from lighthouse_tpu.types import containers as tc
+from lighthouse_tpu.types.helpers import (
+    compute_domain, compute_signing_root, get_domain, is_slashable_attestation_data,
+)
+from lighthouse_tpu.types.spec import mainnet_spec, minimal_spec, FAR_FUTURE_EPOCH
+
+
+class TestShuffle:
+    def test_list_matches_per_index_exact_orientation(self):
+        """Pins the orientation CommitteeCache depends on:
+        shuffle_list(arange, forwards=False)[j] == compute_shuffled_index(j)
+        (so active[shuffled[j]] is the spec committee layout), and the forward
+        list shuffle is its inverse."""
+        seed = bytes(range(32))
+        n, rounds = 47, 10
+        pi = np.array(
+            [compute_shuffled_index(i, n, seed, rounds) for i in range(n)],
+            dtype=np.uint64,
+        )
+        bwd = shuffle_list(np.arange(n), seed, rounds, forwards=False)
+        assert (bwd == pi).all()
+        fwd = shuffle_list(np.arange(n), seed, rounds, forwards=True)
+        assert (fwd[pi.astype(np.int64)] == np.arange(n)).all()
+
+    def test_matches_hashlib_reference(self):
+        """The round hashes must be REAL sha256 (regression for the
+        double-padding bug): re-derive one round pivot with hashlib."""
+        import hashlib
+
+        seed = b"\x07" * 32
+        n, rounds = 11, 3
+        pivot0 = (
+            int.from_bytes(
+                hashlib.sha256(seed + bytes([0])).digest()[:8], "little"
+            )
+            % n
+        )
+        # reimplement round 0 of the per-index walk for index 0 using hashlib
+        cur = 0
+        flip = (pivot0 + n - cur) % n
+        position = max(cur, flip)
+        src = hashlib.sha256(
+            seed + bytes([0]) + (position >> 8).to_bytes(4, "little")
+        ).digest()
+        bit = (src[(position & 0xFF) >> 3] >> (position & 7)) & 1
+        expected0 = flip if bit else cur
+        got = compute_shuffled_index(0, n, seed, 1)
+        assert got == expected0
+
+    def test_forward_backward_inverse(self):
+        seed = b"\xaa" * 32
+        n, rounds = 100, 90
+        fwd = shuffle_list(np.arange(n), seed, rounds, forwards=True)
+        back = shuffle_list(fwd, seed, rounds, forwards=False)
+        assert (back == np.arange(n)).all()
+
+    def test_is_permutation_and_seed_sensitivity(self):
+        n = 333
+        a = shuffle_list(np.arange(n), b"\x01" * 32, 90)
+        b = shuffle_list(np.arange(n), b"\x02" * 32, 90)
+        assert sorted(a) == list(range(n))
+        assert (a != b).any()
+
+
+class TestSpecTypes:
+    def test_fork_schedule(self):
+        spec = mainnet_spec(altair_fork_epoch=5, bellatrix_fork_epoch=10)
+        assert spec.fork_name_at_epoch(0) == "phase0"
+        assert spec.fork_name_at_epoch(5) == "altair"
+        assert spec.fork_name_at_epoch(9) == "altair"
+        assert spec.fork_name_at_epoch(10) == "bellatrix"
+        assert spec.fork_name_at_epoch(FAR_FUTURE_EPOCH - 1) == "bellatrix"
+
+    def test_domains_and_signing_root(self):
+        spec = minimal_spec()
+        ns = tc.for_preset("minimal")
+        state = ns.BeaconState()
+        state.fork = tc.Fork(
+            previous_version=b"\x00" * 4, current_version=b"\x01\x00\x00\x00",
+            epoch=3,
+        )
+        d_cur = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=4)
+        d_prev = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=2)
+        assert d_cur != d_prev
+        assert d_cur[:4] == spec.DOMAIN_BEACON_PROPOSER
+        block = ns.BeaconBlock(slot=1)
+        r = compute_signing_root(block, d_cur)
+        assert len(r) == 32 and r != block.tree_root()
+
+    def test_state_roundtrip_with_validators(self):
+        ns = tc.for_preset("minimal")
+        state = ns.BeaconState()
+        state.validators = [
+            tc.Validator(pubkey=bytes([i]) * 48, effective_balance=32 * 10**9)
+            for i in range(4)
+        ]
+        state.balances = np.full(4, 32 * 10**9, dtype=np.uint64)
+        enc = state.serialize()
+        back = ns.BeaconState.decode(enc)
+        assert back == state
+        assert back.tree_root() == state.tree_root()
+
+    def test_altair_state_has_participation(self):
+        ns = tc.for_preset("minimal")
+        names = [n for n, _ in ns.BeaconStateAltair.FIELDS]
+        assert "previous_epoch_participation" in names
+        assert "previous_epoch_attestations" not in names
+        i_slash = names.index("slashings")
+        assert names[i_slash + 1] == "previous_epoch_participation"
+
+    def test_slashable_attestation_data(self):
+        d1 = tc.AttestationData(
+            source=tc.Checkpoint(epoch=1), target=tc.Checkpoint(epoch=4)
+        )
+        d2 = tc.AttestationData(
+            source=tc.Checkpoint(epoch=2), target=tc.Checkpoint(epoch=3)
+        )
+        assert is_slashable_attestation_data(d1, d2)       # surround
+        d3 = tc.AttestationData(
+            source=tc.Checkpoint(epoch=0), target=tc.Checkpoint(epoch=3),
+            beacon_block_root=b"\x01" * 32,
+        )
+        assert is_slashable_attestation_data(d2, d3)       # double vote
+        assert not is_slashable_attestation_data(d1, d1)   # same data
