@@ -1,0 +1,9 @@
+"""Key management: EIP-2333 derivation, EIP-2335 keystores, EIP-2386 wallets.
+
+Twin of ``crypto/eth2_key_derivation``, ``crypto/eth2_keystore``,
+``crypto/eth2_wallet``.
+"""
+
+from .derivation import derive_child_sk, derive_master_sk, path_to_nodes, derive_sk_from_path
+from .keystore import Keystore, KeystoreError
+from .wallet import Wallet

@@ -1,0 +1,159 @@
+"""Hash-to-curve G2 on device (RFC 9380, suite BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+Split mirrors the suite's structure: ``hash_to_field`` is host-side SHA-256
+(9 hashlib calls per 32-byte message — negligible next to the pairing) that
+yields Fq2 limb arrays; everything algebraic — simplified SWU on the
+3-isogenous curve, the 3-isogeny map, and Budroni–Pintore psi-based cofactor
+clearing — runs branchless on device over the whole message batch at once.
+
+Constants come from the oracle module (``ops.bls_oracle.hash_to_curve``),
+which cross-validates them (h_eff vs psi clearing) in its own tests.
+Parity: blst's hash-or-encode path used by the reference's sign/verify
+(``/root/reference/crypto/bls/src/impls/blst.rs``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import curve, fq, g2, plans, tower
+from ..bls_oracle import hash_to_curve as _oh
+from ..bls_oracle.fields import P, BLS_X, Fq2
+
+# -- host: hash_to_field --------------------------------------------------------------
+
+
+def hash_to_field_batch(msgs: list[bytes], dst: bytes):
+    """[n messages] -> (u0, u1) device fq2 arrays [n, 2, 25] each."""
+    u0s, u1s = [], []
+    for m in msgs:
+        u0, u1 = _oh.hash_to_field_fq2(m, dst, 2)
+        u0s.append(tower.from_ints([u0.c0, u0.c1]))
+        u1s.append(tower.from_ints([u1.c0, u1.c1]))
+    return jnp.stack(u0s), jnp.stack(u1s)
+
+
+# -- device constants -----------------------------------------------------------------
+
+
+def _c2(v: Fq2):
+    return tower.from_ints([v.c0, v.c1])
+
+
+_A = _c2(_oh.ISO_A)
+_B = _c2(_oh.ISO_B)
+_Z = _c2(_oh.SSWU_Z)
+_C1 = _c2(-_oh.ISO_B * _oh.ISO_A.inv())          # -B/A
+_C2 = _c2(_oh.ISO_B * (_oh.SSWU_Z * _oh.ISO_A).inv())  # B/(Z*A)
+
+_KX_NUM = [_c2(k) for k in _oh._K["x_num"]]
+_KX_DEN = [_c2(k) for k in _oh._K["x_den"]]
+_KY_NUM = [_c2(k) for k in _oh._K["y_num"]]
+_KY_DEN = [_c2(k) for k in _oh._K["y_den"]]
+
+
+def _bc(c, like):
+    return jnp.broadcast_to(c, like.shape[:-2] + (2, fq.NLIMBS))
+
+
+# -- device: simplified SWU on E' ----------------------------------------------------
+
+
+def map_to_curve_sswu(u):
+    """u [..., 2, 25] -> affine (x, y) on the isogenous curve E'. Branchless
+    (RFC 9380 6.6.2 with inv0/select semantics)."""
+    u2 = tower.fq2_sqr(u)
+    zu2 = tower.fq2_mul(_bc(_Z, u), u2)
+    tv = plans.carry_norm(tower.fq2_sqr(zu2) + zu2)
+    tv_zero = tower.t_is_zero(tv)
+    tv1 = tower.fq2_inv(tv)  # inv0
+    one = tower.one(2, u.shape[:-2])
+    x1 = tower.fq2_mul(_bc(_C1, u), plans.carry_norm(one + tv1))
+    x1 = tower.t_select(tv_zero, _bc(_C2, u), x1)
+
+    def g_of(x):
+        return plans.carry_norm(
+            tower.fq2_mul(plans.carry_norm(tower.fq2_sqr(x) + _bc(_A, u)), x)
+            + _bc(_B, u)
+        )
+
+    gx1 = g_of(x1)
+    x2 = tower.fq2_mul(zu2, x1)
+    gx2 = g_of(x2)
+    y1, is_sq = tower.fq2_sqrt(gx1)
+    y2, _ok2 = tower.fq2_sqrt(gx2)
+    x = tower.t_select(is_sq, x1, x2)
+    y = tower.t_select(is_sq, y1, y2)
+    flip = tower.fq2_sgn0(u) != tower.fq2_sgn0(y)
+    y = plans.carry_norm(tower.t_select(flip, tower.fq2_neg(tower.t_canon(y)), y))
+    return x, y
+
+
+# -- device: 3-isogeny map ------------------------------------------------------------
+
+
+def iso_map(x, y):
+    """Affine E' point -> projective E2 point [..., 6, 25].
+
+    All four Horner chains share powers of x; each level's four multiplies run
+    as one stacked kernel (fq2_mul_many). Projective output avoids the two
+    inversions: (X:Y:Z) = (x_num * y_den, y * y_num * x_den, x_den * y_den).
+    """
+    tables = [_KX_NUM, _KX_DEN, _KY_NUM, _KY_DEN]
+    max_len = max(len(t) for t in tables)
+    # pad shorter polynomials (x_den is degree 2) with a leading zero
+    # coefficient so all four Horner chains share the same depth
+    zero2 = tower.zero(2)
+    tables = [t + [zero2] * (max_len - len(t)) for t in tables]
+    accs = [_bc(t[-1], x) for t in tables]
+    for lvl in range(max_len - 2, -1, -1):
+        prods = tower.fq2_mul_many([(a, x) for a in accs])
+        accs = [
+            plans.carry_norm(p + _bc(t[lvl], x)) for p, t in zip(prods, tables)
+        ]
+    x_num, x_den, y_num, y_den = accs
+    xz, yz, zz = tower.fq2_mul_many(
+        [(x_num, y_den), (tower.fq2_mul(y, y_num), x_den), (x_den, y_den)]
+    )
+    return jnp.concatenate([xz, yz, zz], axis=-2)
+
+
+# -- device: cofactor clearing (Budroni–Pintore) -------------------------------------
+
+
+def _mul_by_abs_x(p):
+    return curve.scale_fixed(2, p, -BLS_X)  # |x| (BLS_X negative)
+
+
+def clear_cofactor(p):
+    """[x^2-x-1]P + [x-1]psi(P) + psi^2(2P) with x < 0:
+    = [x]([x]P) - [x]P - P + [x]psi(P) - psi(P) + psi^2(2P)
+    where [x]Q = -[|x|]Q."""
+    xP = curve.point_neg(2, _mul_by_abs_x(p))          # [x]P
+    xxP = curve.point_neg(2, _mul_by_abs_x(xP))        # [x^2]P
+    psiP = g2.psi(p)
+    xpsiP = curve.point_neg(2, _mul_by_abs_x(psiP))    # [x]psi(P)
+    psi2_2P = g2.psi(g2.psi(curve.point_dbl(2, p)))
+    acc = curve.point_add(2, xxP, curve.point_neg(2, xP))
+    acc = curve.point_add(2, acc, curve.point_neg(2, p))
+    acc = curve.point_add(2, acc, xpsiP)
+    acc = curve.point_add(2, acc, curve.point_neg(2, psiP))
+    return curve.point_add(2, acc, psi2_2P)
+
+
+# -- full pipeline --------------------------------------------------------------------
+
+
+def map_to_g2(u0, u1):
+    """Device map: two field elements per message -> projective G2 point."""
+    q0 = iso_map(*map_to_curve_sswu(u0))
+    q1 = iso_map(*map_to_curve_sswu(u1))
+    return clear_cofactor(curve.point_add(2, q0, q1))
+
+
+def hash_to_curve_g2(msgs: list[bytes], dst: bytes):
+    """[n messages] -> [n, 6, 25] projective G2 points (device)."""
+    u0, u1 = hash_to_field_batch(msgs, dst)
+    return map_to_g2(u0, u1)

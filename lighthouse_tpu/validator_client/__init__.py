@@ -1,0 +1,4 @@
+"""Validator client (validator_client/* twin): duties-driven signer."""
+
+from .slashing_protection import SlashingDatabase, NotSafe
+from .validator_store import ValidatorStore

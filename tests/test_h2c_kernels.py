@@ -1,0 +1,39 @@
+"""Device hash-to-curve validation against the oracle (RFC 9380 suite)."""
+
+import pytest
+
+import jax
+import numpy as np
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu.ops.bls import g2 as dg2, h2c
+from lighthouse_tpu.ops.bls_oracle import hash_to_curve as oh
+from lighthouse_tpu.ops.bls_oracle.ciphersuite import DST
+
+
+class TestH2C:
+    def test_sswu_and_iso_match_oracle(self):
+        msgs = [b"abc", b"", b"\x00" * 32]
+        u0, u1 = h2c.hash_to_field_batch(msgs, DST)
+        x, y = jax.jit(h2c.map_to_curve_sswu)(u0)
+        from lighthouse_tpu.ops.bls import tower as tw
+
+        for i, m in enumerate(msgs):
+            ou0, _ = oh.hash_to_field_fq2(m, DST, 2)
+            ox, oy = oh.map_to_curve_sswu(ou0)
+            assert tw.fq2_to_oracle(x[i]) == ox
+            assert tw.fq2_to_oracle(y[i]) == oy
+        pts = jax.jit(lambda a, b: h2c.iso_map(*h2c.map_to_curve_sswu(a)))(u0, u1)
+        for i, m in enumerate(msgs):
+            ou0, _ = oh.hash_to_field_fq2(m, DST, 2)
+            oiso = oh.iso_map(oh.map_to_curve_sswu(ou0))
+            got = dg2.to_oracle(pts[i])
+            assert got == oiso
+
+    def test_full_hash_to_curve_matches_oracle(self):
+        msgs = [bytes([i]) * 32 for i in range(3)] + [b"msg"]
+        pts = jax.jit(h2c.map_to_g2)(*h2c.hash_to_field_batch(msgs, DST))
+        for i, m in enumerate(msgs):
+            expected = oh.hash_to_curve_g2(m, DST)
+            got = dg2.to_oracle(pts[i])
+            assert got == expected, f"mismatch for message {i}"

@@ -1,0 +1,180 @@
+"""Key stack (EIP-2333/2335/2386) + validator-store/slashing-protection tests."""
+
+import numpy as np
+import pytest
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu import bls
+from lighthouse_tpu.keys import (
+    Keystore, Wallet, derive_child_sk, derive_master_sk, derive_sk_from_path,
+)
+from lighthouse_tpu.validator_client import NotSafe, SlashingDatabase, ValidatorStore
+from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+class TestDerivation:
+    def test_eip2333_test_vector(self):
+        """Official EIP-2333 test case 0."""
+        seed = bytes.fromhex(
+            "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+            "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+        )
+        master = derive_master_sk(seed)
+        assert master == 6083874454709270928345386274498605044986640685124978867557563392430687146096
+        child = derive_child_sk(master, 0)
+        assert child == 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+    def test_path_derivation(self):
+        seed = b"\x01" * 32
+        a = derive_sk_from_path(seed, "m/12381/3600/0/0/0")
+        b = derive_sk_from_path(seed, "m/12381/3600/1/0/0")
+        assert a != b
+        with pytest.raises(ValueError):
+            derive_sk_from_path(seed, "x/12381")
+
+
+class TestKeystore:
+    def test_encrypt_decrypt_roundtrip(self):
+        secret = bytes(range(32))
+        ks = Keystore.encrypt(secret, "p@ssw0rd", kdf="pbkdf2", path="m/12381/3600/0/0/0")
+        back = Keystore.from_json(ks.to_json())
+        assert back.decrypt("p@ssw0rd") == secret
+        from lighthouse_tpu.keys.keystore import KeystoreError
+
+        with pytest.raises(KeystoreError):
+            back.decrypt("wrong")
+
+    def test_eip2335_pbkdf2_test_vector(self):
+        """Official EIP-2335 pbkdf2 vector: decrypts to the known BLS key."""
+        import json
+
+        vector = {
+            "crypto": {
+                "kdf": {
+                    "function": "pbkdf2",
+                    "params": {
+                        "dklen": 32, "c": 262144, "prf": "hmac-sha256",
+                        "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+                    },
+                    "message": "",
+                },
+                "checksum": {
+                    "function": "sha256", "params": {},
+                    "message": "8a9f5d9912ed7e75ea794bc5a89bca5f193721d30868ade6f73043c6ea6febf1",
+                },
+                "cipher": {
+                    "function": "aes-128-ctr",
+                    "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+                    "message": "cee03fde2af33149775b7223e7845e4fb2c8ae1792e5f99fe9ecf474cc8c16ad",
+                },
+            },
+            "description": "This is a test keystore that uses PBKDF2 to secure the secret.",
+            "pubkey": "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c11f2b7b27f4ae4040902382ae2910c15e2b420d07",
+            "path": "m/12381/60/0/0",
+            "uuid": "64625def-3331-4eea-ab6f-782f3ed16a83",
+            "version": 4,
+        }
+        # EIP-2335 test password: fraktur 'testpassword' + KEY emoji;
+        # NFKD-normalizes to ASCII 'testpassword' + the emoji
+        password = (
+            "\U0001D531\U0001D522\U0001D530\U0001D531\U0001D52D\U0001D51E"
+            "\U0001D530\U0001D530\U0001D534\U0001D52C\U0001D52F\U0001D521"
+            "\U0001F511"
+        )
+        import unicodedata
+
+        assert unicodedata.normalize("NFKD", password) == "testpassword\U0001F511"
+        ks = Keystore.from_json(json.dumps(vector))
+        secret = ks.decrypt(password)
+        assert secret.hex() == (
+            "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+        )
+
+
+class TestWallet:
+    def test_wallet_derives_consistent_validators(self):
+        w = Wallet.create("w", "pw", seed=b"\x02" * 32)
+        v0, wd0 = w.next_validator("pw", "vpw")
+        assert w.nextaccount == 1
+        # keystore path matches EIP-2334 and decrypts to the path-derived key
+        sk = int.from_bytes(v0.decrypt("vpw"), "big")
+        assert sk == derive_sk_from_path(b"\x02" * 32, "m/12381/3600/0/0/0")
+        w2 = Wallet.from_json(w.to_json())
+        assert w2.nextaccount == 1
+
+
+class TestSlashingProtection:
+    def test_block_rules(self):
+        db = SlashingDatabase()
+        pk = b"\x01" * 48
+        db.register_validator(pk)
+        assert db.check_and_insert_block_proposal(pk, 10, b"\xaa" * 32) == "valid"
+        assert db.check_and_insert_block_proposal(pk, 10, b"\xaa" * 32) == "same_data"
+        with pytest.raises(NotSafe):
+            db.check_and_insert_block_proposal(pk, 10, b"\xbb" * 32)  # double
+        with pytest.raises(NotSafe):
+            db.check_and_insert_block_proposal(pk, 9, b"\xcc" * 32)  # below max
+        assert db.check_and_insert_block_proposal(pk, 11, b"\xdd" * 32) == "valid"
+
+    def test_attestation_rules(self):
+        db = SlashingDatabase()
+        pk = b"\x02" * 48
+        db.register_validator(pk)
+        assert db.check_and_insert_attestation(pk, 2, 3, b"\x01" * 32) == "valid"
+        with pytest.raises(NotSafe):  # double vote, different root
+            db.check_and_insert_attestation(pk, 2, 3, b"\x02" * 32)
+        with pytest.raises(NotSafe):  # surrounds (1,4) surrounds (2,3)
+            db.check_and_insert_attestation(pk, 1, 4, b"\x03" * 32)
+        assert db.check_and_insert_attestation(pk, 3, 5, b"\x04" * 32) == "valid"
+        with pytest.raises(NotSafe):  # surrounded by (3,5)
+            db.check_and_insert_attestation(pk, 4, 4, b"\x05" * 32)
+
+    def test_interchange_roundtrip(self):
+        db = SlashingDatabase()
+        pk = b"\x03" * 48
+        db.register_validator(pk)
+        db.check_and_insert_block_proposal(pk, 5, b"\xaa" * 32)
+        db.check_and_insert_attestation(pk, 0, 1, b"\xbb" * 32)
+        exported = db.export_interchange(b"\x00" * 32)
+        db2 = SlashingDatabase()
+        assert db2.import_interchange(exported) == 2
+        with pytest.raises(NotSafe):
+            db2.check_and_insert_block_proposal(pk, 5, b"\xcc" * 32)
+
+
+class TestValidatorStore:
+    def test_store_signs_and_protects(self):
+        spec = minimal_spec()
+        store = ValidatorStore(spec)
+        sk = bls.SecretKey.keygen(b"\x07" * 32)
+        pk = store.add_validator_sk(sk)
+
+        class St:
+            slot = 8
+
+            class fork:
+                previous_version = b"\x00" * 4
+                current_version = b"\x00" * 4
+                epoch = 0
+
+            genesis_validators_root = b"\x00" * 32
+
+        data = AttestationData(
+            slot=8, index=0, beacon_block_root=b"\x01" * 32,
+            source=Checkpoint(epoch=0), target=Checkpoint(epoch=1),
+        )
+        sig = store.sign_attestation(pk, data, St)
+        assert isinstance(sig, bls.Signature)
+        # same data re-sign ok; conflicting target rejected
+        store.sign_attestation(pk, data, St)
+        data2 = AttestationData(
+            slot=8, index=0, beacon_block_root=b"\x02" * 32,
+            source=Checkpoint(epoch=0), target=Checkpoint(epoch=1),
+        )
+        with pytest.raises(NotSafe):
+            store.sign_attestation(pk, data2, St)
+        # doppelganger gate
+        store.doppelganger_suspect.add(pk)
+        with pytest.raises(NotSafe):
+            store.sign_randao(pk, 1, St)
