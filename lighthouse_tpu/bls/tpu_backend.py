@@ -53,6 +53,20 @@ def _aggregate_kernel(k_pad: int):
     return agg
 
 
+def _set_prologue(pk_agg, sig, scalars, valid):
+    """Per-set validity checks + random scaling + masked signature sum.
+
+    The security-critical prologue shared verbatim by the single-chip and
+    sharded kernels: G2 subgroup check (blst.rs:75-78), infinity rejection,
+    random-scalar scaling of pubkeys and signatures, and the masked G2 sum.
+    """
+    sig_grp = g2.subgroup_check(sig)
+    set_ok = ~valid | (sig_grp & ~g1.is_inf(pk_agg) & ~g2.is_inf(sig))
+    pk_scaled = g1.scale_u64(pk_agg, scalars)
+    sig_sum = g2.psum(g2.scale_u64(sig, scalars), valid)
+    return set_ok, pk_scaled, sig_sum
+
+
 @functools.lru_cache(maxsize=None)
 def _verify_kernel(n_pad: int):
     """Batch verification over n_pad sets (padded entries masked by `valid`).
@@ -64,10 +78,7 @@ def _verify_kernel(n_pad: int):
 
     @jax.jit
     def verify(pk_agg, sig, mx, my, scalars, valid):
-        sig_grp = g2.subgroup_check(sig)
-        set_ok = ~valid | (sig_grp & ~g1.is_inf(pk_agg) & ~g2.is_inf(sig))
-        pk_scaled = g1.scale_u64(pk_agg, scalars)
-        sig_acc = g2.psum(g2.scale_u64(sig, scalars), valid)
+        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
         pkx, pky = g1.to_affine(pk_scaled)
         sax, say = g2.to_affine(sig_acc)
         px = jnp.concatenate([pkx[:, 0, :], _MG1_X[None]], axis=0)
@@ -92,6 +103,86 @@ def aggregate_pubkeys_device(pts: list, k_pad: int | None = None):
         buf = buf.at[i, : p.shape[0]].set(p)
         mask[i, : p.shape[0]] = True
     return _aggregate_kernel(k_pad)(buf, jnp.asarray(mask))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_verify_kernel(mesh):
+    """Multi-chip twin of ``_verify_kernel``: dp over signature sets on the
+    mesh's ``sets`` axis. Each device scales its pubkeys/signatures, runs its
+    Miller loops, and forms a local pairing product + local signature partial
+    sum; the cross-device combine (G2 sum + Fq12 product + one final
+    exponentiation) rides the mesh via XLA collectives on the sharded outputs.
+    Reference semantics: ``crypto/bls/src/impls/blst.rs:37-119``.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local_stage(pk_agg, sig, mx, my, scalars, valid):
+        set_ok, pk_scaled, sig_part = _set_prologue(pk_agg, sig, scalars, valid)
+        pkx, pky = g1.to_affine(pk_scaled)
+        fs = pairing.miller_loop(pkx[:, 0, :], pky[:, 0, :], mx, my)
+        fs = tower.t_select(valid, fs, tower.one(12, fs.shape[:-2]))
+        return (
+            pairing.fq12_prod(fs)[None],
+            sig_part[None],
+            jnp.all(set_ok)[None],
+            jnp.any(valid)[None],
+        )
+
+    sharded = shard_map(
+        local_stage,
+        mesh=mesh,
+        in_specs=(P("sets"),) * 6,
+        out_specs=(P("sets"),) * 4,
+    )
+
+    @jax.jit
+    def verify(pk_agg, sig, mx, my, scalars, valid):
+        partial_f, partial_sig, ok_parts, any_parts = sharded(
+            pk_agg, sig, mx, my, scalars, valid
+        )
+        sig_acc = g2.psum(partial_sig)
+        f_all = pairing.fq12_prod(partial_f)
+        sx, sy = g2.to_affine(sig_acc)
+        f_last = pairing.miller_loop(_MG1_X, _MG1_Y, sx, sy)
+        f = tower.fq12_mul(f_all, f_last)
+        ok = tower.fq12_is_one(pairing.final_exponentiation(f))
+        return ok & jnp.all(ok_parts) & jnp.any(any_parts)
+
+    return verify
+
+
+def verify_signature_sets_sharded(
+    pk_agg, sig, msg_x, msg_y, n_real: int, mesh
+) -> bool:
+    """Sharded batch verification over a ``Mesh`` with a ``sets`` axis.
+
+    Pads the batch up to a multiple of the mesh size (padded entries masked
+    invalid), draws fresh 64-bit scalars host-side, and runs the dp +
+    ICI-combine kernel.
+    """
+    if n_real == 0:
+        return False
+    n_dev = mesh.devices.size
+    n = pk_agg.shape[0]
+    n_pad = ((n + n_dev - 1) // n_dev) * n_dev
+    if n_pad != n:
+        pad = n_pad - n
+
+        def _pad(a):
+            return jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], dtype=a.dtype)], axis=0
+            )
+
+        pk_agg, sig, msg_x, msg_y = map(_pad, (pk_agg, sig, msg_x, msg_y))
+    scalars = np.array(
+        [secrets.randbits(RAND_BITS) or 1 for _ in range(n_pad)], dtype=np.uint64
+    )
+    valid = np.arange(n_pad) < n_real
+    ok = _sharded_verify_kernel(mesh)(
+        pk_agg, sig, msg_x, msg_y, jnp.asarray(scalars), jnp.asarray(valid)
+    )
+    return bool(np.asarray(ok))
 
 
 def verify_signature_sets_device(pk_agg, sig, msg_x, msg_y, n_real: int) -> bool:
