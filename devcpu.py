@@ -1,18 +1,51 @@
-"""Import first in dev scripts to force CPU (avoids axon TPU client init).
+"""Force the CPU platform with a virtual multi-device mesh.
 
-Usage: ``python -c "import devcpu, ..."`` or ``import devcpu`` at the top of a
-script run from the repo root. Tests get the same treatment from tests/conftest.py.
+Import first in dev scripts (``import devcpu``) to force CPU before JAX
+initializes — avoids the axon TPU client init (which can block on the
+tunnel). Tests get the same treatment from tests/conftest.py. The platform
+override must use jax.config, not just the env var: the environment's
+sitecustomize registers the axon TPU plugin and force-selects it.
+
+``force_cpu_mesh(n)`` is the late-fallback variant for processes where a
+(broken or too-small) accelerator client may ALREADY be initialized — it
+clears backends and re-initializes CPU with n virtual devices. Shared with
+__graft_entry__.dryrun_multichip.
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+_DEFAULT_DEVICES = 8
 
-import jax
+
+def _set_env(n_devices: int = _DEFAULT_DEVICES) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def force_cpu_mesh(n_devices: int = _DEFAULT_DEVICES):
+    """Force CPU with >= n_devices virtual devices, even if another backend
+    already initialized (clears it). Returns the CPU device list."""
+    _set_env(n_devices)
+    import jax
+
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        from jax._src import xla_bridge
+
+        xla_bridge._clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    return jax.devices("cpu")
+
+
+# import side effect: claim the platform before any JAX client exists
+_set_env()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

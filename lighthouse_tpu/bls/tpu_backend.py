@@ -165,7 +165,8 @@ def verify_signature_sets_sharded(
         return False
     n_dev = mesh.devices.size
     n = pk_agg.shape[0]
-    n_pad = ((n + n_dev - 1) // n_dev) * n_dev
+    # power-of-two bucket (shape-stable compiles), rounded to a mesh multiple
+    n_pad = ((bucket(max(n, n_dev)) + n_dev - 1) // n_dev) * n_dev
     if n_pad != n:
         pad = n_pad - n
 

@@ -1,0 +1,9 @@
+"""Native (C++) runtime components.
+
+``bls12_381.cpp`` is the CPU parity backend for the BLS seam — the role blst
+plays in the reference (``/root/reference/crypto/bls/Cargo.toml`` supranational
+feature). Built on demand with g++ into a shared library cached next to the
+source; loaded via ctypes (no pybind11 in this environment).
+"""
+
+from .build import load_bls  # noqa: F401
