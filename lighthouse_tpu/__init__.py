@@ -11,9 +11,23 @@ accumulate 16-bit-limb products in uint64 lanes.
 """
 
 try:
+    import os as _os
+
     import jax as _jax
 
     _jax.config.update("jax_enable_x64", True)
+    # Persistent XLA compilation cache: the BLS kernels are large programs and
+    # this host compiles them slowly; warm runs (tests, benches, the chain)
+    # must not re-pay compilation. Opt out with LIGHTHOUSE_TPU_NO_JIT_CACHE=1.
+    if not _os.environ.get("LIGHTHOUSE_TPU_NO_JIT_CACHE"):
+        _cache_dir = _os.environ.get(
+            "LIGHTHOUSE_TPU_JIT_CACHE",
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          _os.pardir, ".jax_cache"),
+        )
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except ImportError:  # the pure-Python oracle backend works without jax
     pass
 

@@ -72,3 +72,23 @@ class ValidatorPubkeyCache:
         import jax.numpy as jnp
 
         return arr[jnp.asarray(np.asarray(indices, dtype=np.int64))]
+
+
+def device_pubkeys_from_raw(raw: "np.ndarray"):
+    """Bulk-load raw affine pubkeys ([n, 96] uint8: x||y big-endian, the
+    native backend's bls_pk_decompress output) into the device-resident
+    projective array [n, 3, 25] — the fast path for building a large cache
+    without per-key Python point objects."""
+    import jax.numpy as jnp
+
+    from ..bls.serde import _be_bytes_to_limbs, raw_to_mont
+    from ..ops.bls import tower
+
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    n = raw.shape[0]
+    x = _be_bytes_to_limbs(raw[:, :48])
+    y = _be_bytes_to_limbs(raw[:, 48:])
+    xm = raw_to_mont(jnp.asarray(x))
+    ym = raw_to_mont(jnp.asarray(y))
+    one = jnp.broadcast_to(tower.one(1), (n, 1, xm.shape[-1]))
+    return jnp.concatenate([xm[:, None, :], ym[:, None, :], one], axis=1)

@@ -36,7 +36,7 @@ _BACKEND = "tpu"
 
 def set_backend(name: str) -> None:
     global _BACKEND
-    if name not in ("tpu", "oracle"):
+    if name not in ("tpu", "oracle", "native"):
         raise ValueError(f"unknown bls backend {name!r}")
     _BACKEND = name
 
@@ -195,7 +195,9 @@ def _verify_sets_tpu(sets) -> bool:
     import jax.numpy as jnp
 
     from . import tpu_backend as tb
-    from ..ops.bls import g1 as dg1, g2 as dg2, tower as dtw
+    from ..ops.bls import g1 as dg1, g2 as dg2
+    from ..ops.bls import h2c as dh2c
+    from ..ops.bls_oracle.ciphersuite import DST
 
     n = len(sets)
     if n == 0:
@@ -212,15 +214,34 @@ def _verify_sets_tpu(sets) -> bool:
         [pk_agg, jnp.broadcast_to(pk_agg[:1], (n_pad - n,) + pk_agg.shape[1:])]
     ) if n_pad > n else pk_agg
     sig = dg2.from_oracle_batch([s.signature.point for s in sets])
-    msgs = [_cs.hash_to_g2(s.message) for s in sets]
-    mx = jnp.stack([dtw.from_ints([m[0].c0, m[0].c1]) for m in msgs])
-    my = jnp.stack([dtw.from_ints([m[1].c0, m[1].c1]) for m in msgs])
-    if n_pad > n:
+    # device h2c: host SHA-256 hash_to_field; SSWU/isogeny/cofactor fuse into
+    # the verification kernel (one jit) — no oracle pairing-tower hashing and
+    # no eager op-by-op dispatch on the hot path
+    u0, u1 = dh2c.hash_to_field_batch([s.message for s in sets], DST)
+    if n_pad > n:  # pad by broadcast, not by hashing dummy messages
         pad = lambda a: jnp.concatenate(
             [a, jnp.broadcast_to(a[:1], (n_pad - n,) + a.shape[1:])]
         )
-        sig, mx, my = pad(sig), pad(mx), pad(my)
-    return tb.verify_signature_sets_device(pk_agg, sig, mx, my, n)
+        sig, u0, u1 = pad(sig), pad(u0), pad(u1)
+    return tb.verify_signature_sets_device_h2c(pk_agg, sig, u0, u1, n)
+
+
+def _verify_sets_native(sets) -> bool:
+    import secrets
+
+    from ..native.build import NativeBls
+    from .tpu_backend import RAND_BITS
+
+    nb = NativeBls()
+    try:
+        return nb.verify_signature_sets(
+            [[pk.serialize() for pk in s.signing_keys] for s in sets],
+            [s.message for s in sets],
+            [s.signature.serialize() for s in sets],
+            [secrets.randbits(RAND_BITS) or 1 for _ in sets],
+        )
+    except ValueError:
+        return False
 
 
 def verify_signature_sets(sets) -> bool:
@@ -228,4 +249,6 @@ def verify_signature_sets(sets) -> bool:
     sets = list(sets)
     if _BACKEND == "oracle":
         return _verify_sets_oracle(sets)
+    if _BACKEND == "native":
+        return _verify_sets_native(sets)
     return _verify_sets_tpu(sets)

@@ -24,9 +24,11 @@ _R2 = None  # lazy: R^2 mod p limbs (for to-Montgomery via one mont_mul)
 
 
 def _r2():
+    # cached as HOST numpy: a jnp array built during a jit trace would cache a
+    # tracer and leak it into later calls
     global _R2
     if _R2 is None:
-        _R2 = jnp.asarray(fq.int_to_limbs(fq.R_MONT * fq.R_MONT % P))
+        _R2 = np.asarray(fq.int_to_limbs(fq.R_MONT * fq.R_MONT % P))
     return _R2
 
 
@@ -107,7 +109,9 @@ def parse_g2_bytes(data: np.ndarray):
 
 def raw_to_mont(x):
     """Raw-residue limbs -> Montgomery form on device (one mont_mul by R^2)."""
-    return fq.mont_mul(jnp.asarray(x), jnp.broadcast_to(_r2(), np.shape(x)))
+    return fq.mont_mul(
+        jnp.asarray(x), jnp.broadcast_to(jnp.asarray(_r2()), np.shape(x))
+    )
 
 
 def _limbs_to_be_bytes(limbs: np.ndarray) -> np.ndarray:

@@ -67,6 +67,21 @@ def _set_prologue(pk_agg, sig, scalars, valid):
     return set_ok, pk_scaled, sig_sum
 
 
+def _pairing_epilogue(pk_scaled, sig_acc, mx, my, set_ok, valid):
+    """Shared tail of every verification kernel: affine conversion, append the
+    e(-g1, sig_acc) pair, one multi-pairing with a single final exponentiation,
+    and the combined verdict (pairing & all per-set checks & non-empty)."""
+    pkx, pky = g1.to_affine(pk_scaled)
+    sax, say = g2.to_affine(sig_acc)
+    px = jnp.concatenate([pkx[:, 0, :], _MG1_X[None]], axis=0)
+    py = jnp.concatenate([pky[:, 0, :], _MG1_Y[None]], axis=0)
+    qx = jnp.concatenate([mx, sax[None]], axis=0)
+    qy = jnp.concatenate([my, say[None]], axis=0)
+    pair_valid = jnp.concatenate([valid, jnp.ones((1,), dtype=bool)])
+    ok = pairing.multi_pairing_is_one(px, py, qx, qy, pair_valid)
+    return ok & jnp.all(set_ok) & jnp.any(valid)
+
+
 @functools.lru_cache(maxsize=None)
 def _verify_kernel(n_pad: int):
     """Batch verification over n_pad sets (padded entries masked by `valid`).
@@ -79,17 +94,42 @@ def _verify_kernel(n_pad: int):
     @jax.jit
     def verify(pk_agg, sig, mx, my, scalars, valid):
         set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
-        pkx, pky = g1.to_affine(pk_scaled)
-        sax, say = g2.to_affine(sig_acc)
-        px = jnp.concatenate([pkx[:, 0, :], _MG1_X[None]], axis=0)
-        py = jnp.concatenate([pky[:, 0, :], _MG1_Y[None]], axis=0)
-        qx = jnp.concatenate([mx, sax[None]], axis=0)
-        qy = jnp.concatenate([my, say[None]], axis=0)
-        pair_valid = jnp.concatenate([valid, jnp.ones((1,), dtype=bool)])
-        ok = pairing.multi_pairing_is_one(px, py, qx, qy, pair_valid)
-        return ok & jnp.all(set_ok) & jnp.any(valid)
+        return _pairing_epilogue(pk_scaled, sig_acc, mx, my, set_ok, valid)
 
     return verify
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_kernel_h2c(n_pad: int):
+    """_verify_kernel with device h2c fused in: takes hash_to_field residues
+    (u0, u1) instead of pre-hashed message points, so the SSWU/isogeny/
+    cofactor chain compiles into the same program instead of dispatching
+    eagerly op by op."""
+    from ..ops.bls import h2c
+
+    @jax.jit
+    def verify(pk_agg, sig, u0, u1, scalars, valid):
+        mg2 = h2c.map_to_g2(u0, u1)
+        mx, my = g2.to_affine(mg2)
+        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
+        return _pairing_epilogue(pk_scaled, sig_acc, mx, my, set_ok, valid)
+
+    return verify
+
+
+def verify_signature_sets_device_h2c(pk_agg, sig, u0, u1, n_real: int) -> bool:
+    """Like verify_signature_sets_device but hashing on device (fused)."""
+    n = pk_agg.shape[0]
+    if n_real == 0:
+        return False
+    scalars = np.array(
+        [secrets.randbits(RAND_BITS) or 1 for _ in range(n)], dtype=np.uint64
+    )
+    valid = np.arange(n) < n_real
+    ok = _verify_kernel_h2c(n)(
+        pk_agg, sig, u0, u1, jnp.asarray(scalars), jnp.asarray(valid)
+    )
+    return bool(np.asarray(ok))
 
 
 def aggregate_pubkeys_device(pts: list, k_pad: int | None = None):
@@ -103,6 +143,108 @@ def aggregate_pubkeys_device(pts: list, k_pad: int | None = None):
         buf = buf.at[i, : p.shape[0]].set(p)
         mask[i, : p.shape[0]] = True
     return _aggregate_kernel(k_pad)(buf, jnp.asarray(mask))
+
+
+@functools.lru_cache(maxsize=None)
+def _gathered_kernel(n_pad: int, k_pad: int):
+    """The fully-fused chain hot path: cache-gather + aggregate + device h2c +
+    device signature decompression + RLC batch verification, one jit.
+
+    Inputs:
+      cache  [N, 3, 25]  device-resident decompressed pubkeys (projective)
+      idx    [n, k] int32 validator indices into cache (0-padded)
+      mask   [n, k] bool  which idx entries are real
+      u0/u1  [n, 2, 25]   hash_to_field outputs per message (host SHA-256)
+      sxc0/sxc1 [n, 25]   raw signature x limbs (flags cleared)
+      s_flag [n] uint64   lex-sign bit; sig_wf [n] bool  well-formed encoding
+      scalars [n] uint64  RLC scalars; valid [n] bool    real (non-pad) sets
+
+    Zero per-batch host point conversion: the only H2D traffic is indices,
+    96-byte signature limbs, and hash_to_field residues.
+    """
+    from ..ops.bls import curve, h2c
+    from .serde import raw_to_mont
+
+    @jax.jit
+    def run(cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf, scalars, valid):
+        # messages: device SSWU + isogeny + cofactor clearing
+        mg2 = h2c.map_to_g2(u0, u1)                      # [n, 6, 25] projective
+        mxa, mya = g2.to_affine(mg2)
+        # signatures: device decompression (sqrt + sign select)
+        x_mont = raw_to_mont(jnp.stack([sxc0, sxc1], axis=-2))
+        sig, on_curve = g2.decompress(x_mont, s_flag)
+        # pubkeys: gather + masked tree-sum aggregation
+        pts = cache[idx]                                 # [n, k, 3, 25]
+        pk_agg = curve.point_sum(
+            1, jnp.moveaxis(pts, 1, 0), jnp.moveaxis(mask, 1, 0)
+        )
+        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
+        set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(mask, axis=1)))
+        return _pairing_epilogue(pk_scaled, sig_acc, mxa, mya, set_ok, valid)
+
+    return run
+
+
+def verify_indexed_sets_device(cache_arr, items) -> bool:
+    """Verify signature sets given as (validator_indices, message, sig_bytes)
+    triples against the device-resident pubkey cache.
+
+    The chain's gossip path (attestation_verification/batch.rs semantics): one
+    triple per unaggregated attestation; three per aggregate. Malformed
+    signature bytes or empty index lists fail the batch (callers bisect via
+    the per-set fallback, batch.rs:109-113).
+    """
+    from .serde import parse_g2_bytes
+    from ..ops.bls import h2c
+    from ..ops.bls_oracle.ciphersuite import DST
+
+    n = len(items)
+    if n == 0:
+        return False
+    n_pad = bucket(n)
+    k_pad = bucket(max((len(ix) for ix, _, _ in items), default=1))
+
+    idx = np.zeros((n_pad, k_pad), dtype=np.int32)
+    mask = np.zeros((n_pad, k_pad), dtype=bool)
+    sig_bytes = np.zeros((n_pad, 96), dtype=np.uint8)
+    msgs = []
+    for i, (indices, msg, sb) in enumerate(items):
+        k = len(indices)
+        if k > 0:
+            idx[i, :k] = np.asarray(indices, dtype=np.int32)
+            mask[i, :k] = True
+        msgs.append(msg)
+        sig_bytes[i] = np.frombuffer(sb, dtype=np.uint8)
+
+    parsed = parse_g2_bytes(sig_bytes)
+    sig_wf = parsed["wf_ok"] & ~parsed["is_inf"]
+    u0, u1 = h2c.hash_to_field_batch(msgs, DST)
+    if n_pad > n:  # pad by broadcast, not by hashing dummy messages
+        u0 = jnp.concatenate(
+            [u0, jnp.broadcast_to(u0[:1], (n_pad - n,) + u0.shape[1:])]
+        )
+        u1 = jnp.concatenate(
+            [u1, jnp.broadcast_to(u1[:1], (n_pad - n,) + u1.shape[1:])]
+        )
+
+    scalars = np.array(
+        [secrets.randbits(RAND_BITS) or 1 for _ in range(n_pad)], dtype=np.uint64
+    )
+    valid = np.arange(n_pad) < n
+    ok = _gathered_kernel(n_pad, k_pad)(
+        cache_arr,
+        jnp.asarray(idx),
+        jnp.asarray(mask),
+        u0,
+        u1,
+        jnp.asarray(parsed["x_c0"]),
+        jnp.asarray(parsed["x_c1"]),
+        jnp.asarray(parsed["s_flag"]),
+        jnp.asarray(sig_wf),
+        jnp.asarray(scalars),
+        jnp.asarray(valid),
+    )
+    return bool(np.asarray(ok))
 
 
 @functools.lru_cache(maxsize=None)

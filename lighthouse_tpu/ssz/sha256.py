@@ -72,10 +72,21 @@ def _compress(state: np.ndarray, w0: np.ndarray) -> np.ndarray:
     return state + np.stack([a, b, c, d, e, f, g, h], axis=1)
 
 
+# Below this batch size, per-hash hashlib (C speed) beats the numpy path,
+# whose ~128 python-level rounds cost ~1ms regardless of n.
+_VECTOR_MIN = 2048
+
+
 def sha256_pairs(blocks: np.ndarray) -> np.ndarray:
     """SHA-256 of n 64-byte messages. blocks [n, 64] uint8 -> [n, 32] uint8."""
     blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
     n = blocks.shape[0]
+    if n < _VECTOR_MIN:
+        buf = blocks.tobytes()
+        out = b"".join(
+            hashlib.sha256(buf[64 * i : 64 * i + 64]).digest() for i in range(n)
+        )
+        return np.frombuffer(out, dtype=np.uint8).reshape(n, 32)
     w0 = blocks.view(">u4").astype(np.uint32).reshape(n, 16)
     state = np.broadcast_to(_H0, (n, 8))
     state = _compress(state, w0)
@@ -91,6 +102,13 @@ def sha256_short(msgs: np.ndarray, msg_len: int) -> np.ndarray:
     assert msg_len <= 55, "single-block padding only"
     msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
     n = msgs.shape[0]
+    if n < _VECTOR_MIN:
+        buf = msgs.tobytes()
+        out = b"".join(
+            hashlib.sha256(buf[msg_len * i : msg_len * (i + 1)]).digest()
+            for i in range(n)
+        )
+        return np.frombuffer(out, dtype=np.uint8).reshape(n, 32)
     blocks = np.zeros((n, 64), dtype=np.uint8)
     blocks[:, :msg_len] = msgs
     blocks[:, msg_len] = 0x80

@@ -12,8 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..ops.bls_oracle import ciphersuite as cs
-from ..ops.bls_oracle import curves as oc
+from ..ops.bls_oracle.fields import R as CURVE_ORDER
 from ..types.containers import Checkpoint, for_preset
 from ..types.helpers import compute_signing_root, get_domain
 from ..types.spec import ChainSpec
@@ -38,12 +37,18 @@ class StateHarness:
         self.ns = for_preset(spec.preset.name)
         self.sks = interop_secret_keys(n_validators)
         self.state = interop_genesis_state(spec, n_validators, genesis_time)
+        # sign through the native C++ backend: the harness produces thousands
+        # of signatures per multi-epoch test and the oracle takes ~1s each
+        from ..native.build import NativeBls
+
+        self._nb = NativeBls()
 
     # -- signing helpers ----------------------------------------------------------
 
     def _sign(self, sk_index: int, signing_root: bytes) -> bytes:
-        sig = cs.sign(self.sks[sk_index], signing_root)
-        return oc.g2_compress(sig)
+        return self._nb.sign(
+            self.sks[sk_index].to_bytes(32, "big"), signing_root
+        )
 
     def randao_reveal(self, state, proposer: int, epoch: int) -> bytes:
         domain = get_domain(self.spec, state, self.spec.DOMAIN_RANDAO, epoch=epoch)
@@ -80,18 +85,92 @@ class StateHarness:
                 target=Checkpoint(epoch=epoch, root=target_root),
             )
             root = compute_signing_root(data, domain)
-            sig = None
-            for v in committee:
-                s = cs.sign(self.sks[int(v)], root)
-                sig = oc.g2_add(sig, s)
+            # aggregate of individual signatures == one signature by the
+            # summed secret key (saves len(committee)-1 native signs)
+            agg_sk = sum(self.sks[int(v)] for v in committee) % CURVE_ORDER
+            sig = self._nb.sign(agg_sk.to_bytes(32, "big"), root)
             atts.append(
                 self.ns.Attestation(
                     aggregation_bits=np.ones(committee.size, dtype=bool),
                     data=data,
-                    signature=oc.g2_compress(sig),
+                    signature=sig,
                 )
             )
         return atts
+
+    def unaggregated_attestations_for_slot(
+        self, state, slot: int, head_root: bytes
+    ) -> list:
+        """One single-bit attestation per committee member (the gossip-subnet
+        shape that feeds batch_verify_unaggregated_attestations)."""
+        spec = self.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        target_root = (
+            head_root
+            if slot == spec.start_slot(epoch)
+            else get_block_root_at_slot(spec, state, spec.start_slot(epoch))
+        )
+        domain = get_domain(spec, state, spec.DOMAIN_BEACON_ATTESTER, epoch=epoch)
+        from ..types.containers import AttestationData
+
+        atts = []
+        for index in range(get_committee_count_per_slot(spec, state, epoch)):
+            committee = get_beacon_committee(spec, state, slot, index)
+            data = AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=target_root),
+            )
+            root = compute_signing_root(data, domain)
+            for pos, v in enumerate(committee):
+                bits = np.zeros(committee.size, dtype=bool)
+                bits[pos] = True
+                atts.append(
+                    self.ns.Attestation(
+                        aggregation_bits=bits,
+                        data=data,
+                        signature=self._sign(int(v), root),
+                    )
+                )
+        return atts
+
+    def signed_aggregate_and_proofs(
+        self, state, slot: int, head_root: bytes
+    ) -> list:
+        """One SignedAggregateAndProof per committee: the first committee
+        member plays aggregator (selection-proof gossip checks are the
+        scheduler's job; signatures here are real)."""
+        spec = self.spec
+        from ..types.containers import SigningData
+
+        saps = []
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        dom_sel = get_domain(
+            spec, state, spec.DOMAIN_SELECTION_PROOF, epoch=epoch
+        )
+        dom_ap = get_domain(
+            spec, state, spec.DOMAIN_AGGREGATE_AND_PROOF, epoch=epoch
+        )
+        root_sel = SigningData(
+            object_root=uint64.hash_tree_root(slot), domain=dom_sel
+        ).tree_root()
+        for index, att in enumerate(
+            self.attestations_for_slot(state, slot, head_root)
+        ):
+            committee = get_beacon_committee(spec, state, slot, index)
+            aggor = int(committee[0])
+            agg = self.ns.AggregateAndProof(
+                aggregator_index=aggor,
+                aggregate=att,
+                selection_proof=self._sign(aggor, root_sel),
+            )
+            sig = self._sign(aggor, compute_signing_root(agg, dom_ap))
+            saps.append(
+                self.ns.SignedAggregateAndProof(message=agg, signature=sig)
+            )
+        return saps
 
     # -- blocks -------------------------------------------------------------------
 
@@ -149,15 +228,17 @@ class StateHarness:
 
         signing_root = SigningData(object_root=root, domain=domain).tree_root()
         pk_to_idx = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
-        sig = None
+        agg_sk = 0
         bits = []
         for pk in state.current_sync_committee.pubkeys:
             idx = pk_to_idx[bytes(pk)]
             bits.append(True)
-            sig = oc.g2_add(sig, cs.sign(self.sks[idx], signing_root))
+            agg_sk = (agg_sk + self.sks[idx]) % CURVE_ORDER
         return self.ns.SyncAggregate(
             sync_committee_bits=np.array(bits, dtype=bool),
-            sync_committee_signature=oc.g2_compress(sig),
+            sync_committee_signature=self._nb.sign(
+                agg_sk.to_bytes(32, "big"), signing_root
+            ),
         )
 
     def apply_block(self, signed_block, strategy=BlockSignatureStrategy.VERIFY_BULK):

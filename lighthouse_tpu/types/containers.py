@@ -172,6 +172,21 @@ def for_preset(preset_name: str) -> SimpleNamespace:
             ("proposer_index", ValidatorIndex),
         ]
 
+    class AggregateAndProof(Container):
+        """Gossip aggregate envelope (consensus/types/src/aggregate_and_proof.rs)."""
+
+        FIELDS = [
+            ("aggregator_index", ValidatorIndex),
+            ("aggregate", Attestation),
+            ("selection_proof", BLSSignature),
+        ]
+
+    class SignedAggregateAndProof(Container):
+        FIELDS = [
+            ("message", AggregateAndProof),
+            ("signature", BLSSignature),
+        ]
+
     class AttesterSlashing(Container):
         FIELDS = [
             ("attestation_1", IndexedAttestation),
@@ -296,6 +311,8 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         Attestation=Attestation,
         PendingAttestation=PendingAttestation,
         AttesterSlashing=AttesterSlashing,
+        AggregateAndProof=AggregateAndProof,
+        SignedAggregateAndProof=SignedAggregateAndProof,
         HistoricalBatch=HistoricalBatch,
         SyncCommittee=SyncCommittee,
         SyncAggregate=SyncAggregate,

@@ -20,8 +20,9 @@ N = 16
 
 
 @pytest.fixture(scope="module", autouse=True)
-def oracle_backend():
-    bls.set_backend("oracle")
+def native_backend():
+    # native C++ backend: real crypto at CPU speed for consensus-logic tests
+    bls.set_backend("native")
     yield
     bls.set_backend("tpu")
 

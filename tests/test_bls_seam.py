@@ -56,23 +56,19 @@ class TestSeam:
 
     def test_verify_signature_sets_backends_agree(self):
         sets = _sets()
-        bls.set_backend("oracle")
-        try:
-            assert bls.verify_signature_sets(sets)
-        finally:
-            bls.set_backend("tpu")
-        assert bls.verify_signature_sets(sets)
-        # poison one set: both backends reject
+        # poisoned twin: set 1 carries set 0's signature
         bad = list(sets)
         bad[1] = bls.SignatureSet.multiple_pubkeys(
             bad[0].signature, bad[1].signing_keys, bad[1].message
         )
-        assert not bls.verify_signature_sets(bad)
-        bls.set_backend("oracle")
+        prev = bls.get_backend()
         try:
-            assert not bls.verify_signature_sets(bad)
+            for backend in ("oracle", "native", "tpu"):
+                bls.set_backend(backend)
+                assert bls.verify_signature_sets(sets), backend
+                assert not bls.verify_signature_sets(bad), backend
         finally:
-            bls.set_backend("tpu")
+            bls.set_backend(prev)
 
     def test_empty_and_infinity_sets(self):
         assert not bls.verify_signature_sets([])

@@ -15,6 +15,9 @@ from lighthouse_tpu.ops.bls import curve, fq, g1, g2, tower
 from lighthouse_tpu.ops.bls_oracle import curves as OC
 from lighthouse_tpu.ops.bls_oracle.fields import P, Fq2, fq_sqrt
 
+pytestmark = pytest.mark.slow  # nightly tier: exhaustive kernel parity
+
+
 RNG = np.random.default_rng(42)
 
 

@@ -10,6 +10,9 @@ from lighthouse_tpu.ops.bls import g2 as dg2, h2c
 from lighthouse_tpu.ops.bls_oracle import hash_to_curve as oh
 from lighthouse_tpu.ops.bls_oracle.ciphersuite import DST
 
+pytestmark = pytest.mark.slow  # nightly tier: exhaustive kernel parity
+
+
 
 class TestH2C:
     def test_sswu_and_iso_match_oracle(self):

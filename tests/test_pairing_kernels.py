@@ -14,6 +14,10 @@ import jax.numpy as jnp
 import lighthouse_tpu  # noqa: F401
 from lighthouse_tpu.ops.bls import fq, pairing as dp, tower as tw
 from lighthouse_tpu.ops.bls_oracle import curves as oc, fields as of
+import pytest
+
+pytestmark = pytest.mark.slow  # nightly tier: exhaustive kernel parity
+
 
 # the bls_oracle package __init__ rebinds the name `pairing` to the function,
 # so `from ... import pairing` (and `import ...pairing as op`, which also

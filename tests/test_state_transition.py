@@ -1,7 +1,7 @@
 """End-to-end state transition tests on the minimal preset.
 
 The harness drives real interop-signed blocks through per_block_processing
-with the oracle BLS backend (fast, CPU) — mirroring the reference's
+with the native C++ BLS backend (fast, CPU) — mirroring the reference's
 BeaconChainHarness tests (beacon_chain/tests). Epoch-boundary runs exercise
 justification/finalization with full participation.
 """
@@ -27,8 +27,9 @@ N_VALIDATORS = 32
 
 
 @pytest.fixture(scope="module", autouse=True)
-def oracle_backend():
-    bls.set_backend("oracle")
+def native_backend():
+    # native C++ backend: real crypto at CPU speed for consensus-logic tests
+    bls.set_backend("native")
     yield
     bls.set_backend("tpu")
 
