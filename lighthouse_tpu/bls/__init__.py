@@ -45,6 +45,12 @@ def get_backend() -> str:
     return _BACKEND
 
 
+def _native():
+    from ..native.build import NativeBls
+
+    return NativeBls()
+
+
 class BlsError(Exception):
     """Deserialization / validation failure (reference: bls::Error)."""
 
@@ -97,6 +103,13 @@ class Signature:
         return _oc.g2_compress(self.point)
 
     def verify(self, pubkey: PublicKey, message: bytes) -> bool:
+        # single-op dispatch: native backend verifies in C++; the tpu backend
+        # delegates singles to the oracle (device round-trips only pay off in
+        # batches — verify_signature_sets is the batched path)
+        if _BACKEND == "native":
+            return _native().verify(
+                pubkey.serialize(), message, _oc.g2_compress(self.point)
+            )
         return _cs.verify(pubkey.point, message, self.point)
 
 
@@ -126,6 +139,12 @@ class AggregateSignature:
         return cls(Signature.from_bytes(data).point)
 
     def fast_aggregate_verify(self, message: bytes, pubkeys) -> bool:
+        if _BACKEND == "native":
+            return _native().fast_aggregate_verify(
+                [pk.serialize() for pk in pubkeys],
+                message,
+                _oc.g2_compress(self.point),
+            )
         return _cs.fast_aggregate_verify(
             [pk.point for pk in pubkeys], message, self.point
         )
@@ -160,6 +179,10 @@ class SecretKey:
         return PublicKey(_cs.sk_to_pk(self.scalar))
 
     def sign(self, message: bytes) -> Signature:
+        if _BACKEND == "native":
+            return Signature.from_bytes(
+                _native().sign(self.serialize(), message)
+            )
         return Signature(_cs.sign(self.scalar, message))
 
 

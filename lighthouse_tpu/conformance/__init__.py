@@ -1,0 +1,18 @@
+"""Spec-conformance harness — the twin of the reference's EF-test runner.
+
+The reference pins its state transition and BLS backends to the official
+``consensus-spec-tests`` vectors via a Handler/Case runner
+(``/root/reference/testing/ef_tests/src/handler.rs:13-99``) plus a script
+asserting every vector file on disk was consumed (``Makefile:126-131``,
+``check_all_files_accessed.py``). This environment has no network, so the
+vectors here are GOLDEN vectors generated once from the trusted oracle +
+harness (``generate.py``) and checked in under ``tests/vectors/``; the runner
+(``handler.py``) walks the tree with the same all-files-consumed discipline —
+any vector file the runner does not consume fails the run, so silently
+skipped coverage is impossible.
+
+Layout (mirrors consensus-spec-tests):
+    tests/vectors/<config>/<fork>/<runner>/<handler>/<case>/...
+"""
+
+from .handler import ConformanceError, run_all  # noqa: F401

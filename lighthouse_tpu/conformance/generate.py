@@ -1,0 +1,397 @@
+"""Generate the golden conformance vectors under tests/vectors/.
+
+Run once (``python -m lighthouse_tpu.conformance.generate``) and commit the
+output. Vectors are produced from the trusted oracle ciphersuite and the
+state harness — the runner (handler.py) then exercises the real verification
+and state-transition paths against them, per backend. The reference's
+equivalent inputs are the official consensus-spec-tests; here they are
+self-generated because the environment has no network (SURVEY §4 tier 1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+
+def _w(path: str, name: str, data) -> None:
+    os.makedirs(path, exist_ok=True)
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(os.path.join(path, name), mode) as f:
+        if isinstance(data, bytes):
+            f.write(data)
+        else:
+            json.dump(data, f, indent=1)
+
+
+def _case_dir(root, config, fork, runner, handler, idx):
+    return os.path.join(root, config, fork, runner, handler, f"case_{idx}")
+
+
+def gen_bls(root: str, config: str = "general") -> None:
+    from ..ops.bls_oracle import ciphersuite as cs
+    from ..ops.bls_oracle import curves as oc
+
+    fork = "phase0"
+
+    def hx(b: bytes) -> str:
+        return b.hex()
+
+    sks = [cs.keygen_from_ikm(bytes([i]) * 32) for i in range(1, 5)]
+    pks = [oc.g1_compress(cs.sk_to_pk(sk)).hex() for sk in sks]
+    msg = b"\x11" * 32
+    sigs = [oc.g2_compress(cs.sign(sk, msg)).hex() for sk in sks]
+
+    # sign
+    for i, sk in enumerate(sks[:2]):
+        _w(
+            _case_dir(root, config, fork, "bls", "sign", i),
+            "data.json",
+            {
+                "input": {"privkey": sk.to_bytes(32, "big").hex(), "message": hx(msg)},
+                "output": sigs[i],
+            },
+        )
+    # verify: valid, wrong message, wrong key, infinity sig
+    cases = [
+        ({"pubkey": pks[0], "message": hx(msg), "signature": sigs[0]}, True),
+        ({"pubkey": pks[0], "message": hx(b"\x22" * 32), "signature": sigs[0]}, False),
+        ({"pubkey": pks[1], "message": hx(msg), "signature": sigs[0]}, False),
+        (
+            {
+                "pubkey": pks[0],
+                "message": hx(msg),
+                "signature": (b"\xc0" + b"\x00" * 95).hex(),
+            },
+            False,
+        ),
+    ]
+    for i, (inp, out) in enumerate(cases):
+        _w(
+            _case_dir(root, config, fork, "bls", "verify", i),
+            "data.json",
+            {"input": inp, "output": out},
+        )
+    # aggregate
+    agg = None
+    for sk in sks:
+        agg = oc.g2_add(agg, cs.sign(sk, msg))
+    _w(
+        _case_dir(root, config, fork, "bls", "aggregate", 0),
+        "data.json",
+        {"input": sigs, "output": oc.g2_compress(agg).hex()},
+    )
+    # fast_aggregate_verify: valid + one wrong-key
+    _w(
+        _case_dir(root, config, fork, "bls", "fast_aggregate_verify", 0),
+        "data.json",
+        {
+            "input": {
+                "pubkeys": pks,
+                "message": hx(msg),
+                "signature": oc.g2_compress(agg).hex(),
+            },
+            "output": True,
+        },
+    )
+    _w(
+        _case_dir(root, config, fork, "bls", "fast_aggregate_verify", 1),
+        "data.json",
+        {
+            "input": {
+                "pubkeys": pks[:3],
+                "message": hx(msg),
+                "signature": oc.g2_compress(agg).hex(),
+            },
+            "output": False,
+        },
+    )
+    # batch_verify: all valid; one poisoned
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    sets = []
+    for i, m in enumerate(msgs):
+        a = None
+        for sk in sks[: i + 2]:
+            a = oc.g2_add(a, cs.sign(sk, m))
+        sets.append(
+            {
+                "pubkeys": pks[: i + 2],
+                "message": m.hex(),
+                "signature": oc.g2_compress(a).hex(),
+            }
+        )
+    _w(
+        _case_dir(root, config, fork, "bls", "batch_verify", 0),
+        "data.json",
+        {"input": {"sets": sets}, "output": True},
+    )
+    poisoned = [dict(s) for s in sets]
+    poisoned[1]["signature"] = poisoned[0]["signature"]
+    _w(
+        _case_dir(root, config, fork, "bls", "batch_verify", 1),
+        "data.json",
+        {"input": {"sets": poisoned}, "output": False},
+    )
+
+
+def gen_shuffling(root: str, config: str = "minimal") -> None:
+    from ..ops.shuffle import shuffle_list
+    from ..types.spec import mainnet_spec, minimal_spec
+
+    spec = minimal_spec() if config == "minimal" else mainnet_spec()
+    rounds = spec.preset.SHUFFLE_ROUND_COUNT
+    for i, (seed_byte, count) in enumerate([(0x42, 8), (0x07, 33), (0xA5, 100)]):
+        seed = bytes([seed_byte]) * 32
+        mapping = np.asarray(
+            shuffle_list(np.arange(count, dtype=np.uint64), seed, rounds)
+        ).tolist()
+        _w(
+            _case_dir(root, config, "phase0", "shuffling", "core", i),
+            "mapping.json",
+            {"seed": seed.hex(), "count": count, "mapping": mapping},
+        )
+
+
+def _harness(fork: str, n=32):
+    from ..testing.harness import StateHarness
+    from ..types.spec import minimal_spec
+
+    spec = minimal_spec(altair_fork_epoch=0) if fork == "altair" else minimal_spec()
+    return StateHarness(spec, n)
+
+
+def gen_ssz_static(root: str, config: str = "minimal") -> None:
+    for fork in ("phase0", "altair"):
+        h = _harness(fork)
+        h.extend_chain(3)
+        state = h.state
+        block = h.produce_block(state.slot + 1)
+        objs = {
+            "BeaconState": (type(state), state),
+            "SignedBeaconBlock": (type(block), block),
+        }
+        atts = h.attestations_for_slot(
+            state, state.slot, state.latest_block_header.tree_root()
+        )
+        if atts:
+            objs["Attestation"] = (type(atts[0]), atts[0])
+        for name, (cls, value) in objs.items():
+            d = _case_dir(root, config, fork, "ssz_static", name, 0)
+            _w(d, "serialized.ssz", cls.encode(value))
+            _w(d, "root.json", {"root": value.tree_root().hex()})
+
+
+def gen_operations(root: str, config: str = "minimal") -> None:
+    from ..state_transition import process_slots
+    from ..types.helpers import compute_signing_root, get_domain
+
+    fork = "phase0"
+    h = _harness(fork)
+    h.extend_chain(2)
+    spec = h.spec
+    state_cls = type(h.state)
+
+    # --- attestation: valid + bad-target error case
+    prev = h.state
+    att = h.attestations_for_slot(prev, prev.slot, h.head_root(prev))[0]
+    pre = prev.copy()
+    process_slots(spec, pre, prev.slot + spec.min_attestation_inclusion_delay)
+    d = _case_dir(root, config, fork, "operations", "attestation", 0)
+    _w(d, "pre.ssz", state_cls.encode(pre))
+    _w(d, "attestation.ssz", type(att).encode(att))
+    post = pre.copy()
+    from .handler import _op_attestation
+
+    _op_attestation(spec, post, att)
+    _w(d, "post.ssz", state_cls.encode(post))
+
+    bad = type(att).decode(type(att).encode(att))
+    bad.data.target.root = b"\xde" * 32
+    d = _case_dir(root, config, fork, "operations", "attestation", 1)
+    _w(d, "pre.ssz", state_cls.encode(pre))
+    _w(d, "attestation.ssz", type(bad).encode(bad))
+    _w(d, "meta.json", {"error": True})
+
+    # --- voluntary exit: advance past shard_committee_period
+    from ..types.containers import SignedVoluntaryExit, VoluntaryExit
+
+    exit_state = h.state.copy()
+    target_epoch = spec.shard_committee_period + 1
+    process_slots(spec, exit_state, target_epoch * spec.preset.SLOTS_PER_EPOCH)
+    exit_msg = VoluntaryExit(epoch=target_epoch, validator_index=3)
+    domain = get_domain(
+        spec, exit_state, spec.DOMAIN_VOLUNTARY_EXIT, epoch=target_epoch
+    )
+    sig = h._sign(3, compute_signing_root(exit_msg, domain))
+    sve = SignedVoluntaryExit(message=exit_msg, signature=sig)
+    d = _case_dir(root, config, fork, "operations", "voluntary_exit", 0)
+    _w(d, "pre.ssz", state_cls.encode(exit_state))
+    _w(d, "voluntary_exit.ssz", SignedVoluntaryExit.encode(sve))
+    post = exit_state.copy()
+    from .handler import _op_exit
+
+    _op_exit(spec, post, sve)
+    _w(d, "post.ssz", state_cls.encode(post))
+    # error twin: wrong signature
+    bad = SignedVoluntaryExit(message=exit_msg, signature=h._sign(4, b"\x00" * 32))
+    d = _case_dir(root, config, fork, "operations", "voluntary_exit", 1)
+    _w(d, "pre.ssz", state_cls.encode(exit_state))
+    _w(d, "voluntary_exit.ssz", SignedVoluntaryExit.encode(bad))
+    _w(d, "meta.json", {"error": True})
+
+    # --- proposer slashing: two conflicting headers by validator 0
+    from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
+    from ..types.containers import ProposerSlashing
+
+    st = h.state
+    slot = st.slot
+    proposer = 0
+    hdrs = []
+    for i, body_root in enumerate((b"\x01" * 32, b"\x02" * 32)):
+        header = BeaconBlockHeader(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=b"\x03" * 32,
+            state_root=b"\x04" * 32,
+            body_root=body_root,
+        )
+        dom = get_domain(
+            spec, st, spec.DOMAIN_BEACON_PROPOSER,
+            epoch=spec.compute_epoch_at_slot(slot),
+        )
+        hdrs.append(
+            SignedBeaconBlockHeader(
+                message=header,
+                signature=h._sign(proposer, compute_signing_root(header, dom)),
+            )
+        )
+    ps = ProposerSlashing(signed_header_1=hdrs[0], signed_header_2=hdrs[1])
+    d = _case_dir(root, config, fork, "operations", "proposer_slashing", 0)
+    _w(d, "pre.ssz", state_cls.encode(st))
+    _w(d, "proposer_slashing.ssz", ProposerSlashing.encode(ps))
+    post = st.copy()
+    from .handler import _op_proposer_slashing
+
+    _op_proposer_slashing(spec, post, ps)
+    _w(d, "post.ssz", state_cls.encode(post))
+    # error twin: identical headers (not slashable)
+    same = ProposerSlashing(signed_header_1=hdrs[0], signed_header_2=hdrs[0])
+    d = _case_dir(root, config, fork, "operations", "proposer_slashing", 1)
+    _w(d, "pre.ssz", state_cls.encode(st))
+    _w(d, "proposer_slashing.ssz", ProposerSlashing.encode(same))
+    _w(d, "meta.json", {"error": True})
+
+    # --- attester slashing: double vote by one committee
+    from ..state_transition import get_beacon_committee
+    from ..types.containers import AttestationData, Checkpoint
+
+    st2 = h.state
+    committee = get_beacon_committee(spec, st2, st2.slot, 0)
+    epoch = spec.compute_epoch_at_slot(st2.slot)
+    datas = []
+    for root_byte in (0x0A, 0x0B):
+        datas.append(
+            AttestationData(
+                slot=st2.slot,
+                index=0,
+                beacon_block_root=bytes([root_byte]) * 32,
+                source=st2.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=bytes([root_byte]) * 32),
+            )
+        )
+    dom = get_domain(spec, st2, spec.DOMAIN_BEACON_ATTESTER, epoch=epoch)
+    ns = h.ns
+    from ..ops.bls_oracle.fields import R as CURVE_ORDER
+
+    indexed = []
+    for data in datas:
+        agg_sk = sum(h.sks[int(v)] for v in committee) % CURVE_ORDER
+        indexed.append(
+            ns.IndexedAttestation(
+                attesting_indices=sorted(int(v) for v in committee),
+                data=data,
+                signature=h._nb.sign(
+                    agg_sk.to_bytes(32, "big"), compute_signing_root(data, dom)
+                ),
+            )
+        )
+    aslash = ns.AttesterSlashing(attestation_1=indexed[0], attestation_2=indexed[1])
+    d = _case_dir(root, config, fork, "operations", "attester_slashing", 0)
+    _w(d, "pre.ssz", state_cls.encode(st2))
+    _w(d, "attester_slashing.ssz", ns.AttesterSlashing.encode(aslash))
+    post = st2.copy()
+    from .handler import _op_attester_slashing
+
+    _op_attester_slashing(spec, post, aslash)
+    _w(d, "post.ssz", state_cls.encode(post))
+    # error twin: same attestation twice
+    same = ns.AttesterSlashing(attestation_1=indexed[0], attestation_2=indexed[0])
+    d = _case_dir(root, config, fork, "operations", "attester_slashing", 1)
+    _w(d, "pre.ssz", state_cls.encode(st2))
+    _w(d, "attester_slashing.ssz", ns.AttesterSlashing.encode(same))
+    _w(d, "meta.json", {"error": True})
+
+
+def gen_epoch_processing(root: str, config: str = "minimal") -> None:
+    from ..state_transition import process_epoch, process_slots
+
+    for fork in ("phase0", "altair"):
+        h = _harness(fork)
+        h.extend_chain(h.spec.preset.SLOTS_PER_EPOCH + 2)
+        state = h.state.copy()
+        # advance to the last slot of the epoch; pre = state ready for epoch proc
+        spe = h.spec.preset.SLOTS_PER_EPOCH
+        target = (state.slot // spe + 1) * spe - 1
+        process_slots(h.spec, state, target)
+        state_cls = type(state)
+        d = _case_dir(root, config, fork, "epoch_processing", "full", 0)
+        _w(d, "pre.ssz", state_cls.encode(state))
+        post = state.copy()
+        process_epoch(h.spec, post)
+        _w(d, "post.ssz", state_cls.encode(post))
+
+
+def gen_sanity_blocks(root: str, config: str = "minimal") -> None:
+    for fork in ("phase0", "altair"):
+        h = _harness(fork)
+        h.extend_chain(2)
+        pre = h.state.copy()
+        state_cls = type(pre)
+        blocks = []
+        for _ in range(3):
+            slot = h.state.slot + 1
+            atts = []
+            prev = h.state
+            if prev.slot + h.spec.min_attestation_inclusion_delay <= slot:
+                atts = h.attestations_for_slot(prev, prev.slot, h.head_root(prev))
+            block = h.produce_block(slot, attestations=atts)
+            h.apply_block(block)
+            blocks.append(block)
+        d = _case_dir(root, config, fork, "sanity_blocks", "chain", 0)
+        _w(d, "pre.ssz", state_cls.encode(pre))
+        for i, b in enumerate(blocks):
+            _w(d, f"blocks_{i}.ssz", type(b).encode(b))
+        _w(d, "post.ssz", state_cls.encode(h.state))
+
+
+def main(root: str | None = None) -> None:
+    from .handler import default_vector_root
+
+    root = root or default_vector_root()
+    if os.path.isdir(root):
+        shutil.rmtree(root)
+    gen_bls(root)
+    gen_shuffling(root)
+    gen_ssz_static(root)
+    gen_operations(root)
+    gen_epoch_processing(root)
+    gen_sanity_blocks(root)
+    n = sum(len(fs) for _, _, fs in os.walk(root))
+    print(f"wrote {n} vector files under {root}")
+
+
+if __name__ == "__main__":
+    main()

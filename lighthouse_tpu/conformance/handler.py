@@ -1,0 +1,389 @@
+"""Conformance runner: walk the vector tree, run every case, consume every file.
+
+Twin of ``testing/ef_tests/src/handler.rs:13-99`` (Handler walks
+fork/handler/suite dirs, one Case impl per family) combined with the
+``check_all_files_accessed.py`` discipline (``Makefile:126-131``): ``run_all``
+records every file each case reads and fails if ANY file under the vector
+root was not consumed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+class ConformanceError(AssertionError):
+    pass
+
+
+class CaseContext:
+    """Tracks file consumption for one case directory."""
+
+    def __init__(self, path: str, tracker: set):
+        self.path = path
+        self._tracker = tracker
+
+    def read(self, name: str) -> bytes:
+        p = os.path.join(self.path, name)
+        with open(p, "rb") as f:
+            data = f.read()
+        self._tracker.add(os.path.abspath(p))
+        return data
+
+    def json(self, name: str):
+        return json.loads(self.read(name).decode())
+
+    def has(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self.path, name))
+
+
+# ---------------------------------------------------------------------------
+# Case implementations, keyed by runner name (directory level under the fork)
+# ---------------------------------------------------------------------------
+
+
+def _ns_and_spec(config: str, fork: str):
+    from ..types.containers import for_preset
+    from ..types.spec import mainnet_spec, minimal_spec
+
+    mk = minimal_spec if config == "minimal" else mainnet_spec
+    # vectors for a fork are generated with that fork active from genesis
+    spec = mk(altair_fork_epoch=0) if fork == "altair" else mk()
+    return for_preset(spec.preset.name), spec
+
+
+def _ssz_type(ns, fork: str, name: str):
+    """Resolve a container class by its spec name for the given fork."""
+    per_fork = {
+        "BeaconState": {"phase0": ns.BeaconState, "altair": ns.BeaconStateAltair},
+        "SignedBeaconBlock": {
+            "phase0": ns.SignedBeaconBlock,
+            "altair": ns.SignedBeaconBlockAltair,
+        },
+    }
+    if name in per_fork:
+        return per_fork[name][fork]
+    fixed = {
+        "Attestation": ns.Attestation,
+        "IndexedAttestation": ns.IndexedAttestation,
+        "AttesterSlashing": ns.AttesterSlashing,
+        "AggregateAndProof": ns.AggregateAndProof,
+        "SignedAggregateAndProof": ns.SignedAggregateAndProof,
+        "SyncAggregate": ns.SyncAggregate,
+        "SyncCommittee": ns.SyncCommittee,
+    }
+    if name in fixed:
+        return fixed[name]
+    from ..types import containers as c
+
+    return getattr(c, name)
+
+
+def case_ssz_static(ctx: CaseContext, config: str, fork: str, handler: str):
+    """serialized.ssz must decode, re-encode byte-identical, and tree-root to
+    root.json (ssz_static family, testing/ef_tests/src/cases/ssz_static.rs)."""
+    ns, _ = _ns_and_spec(config, fork)
+    cls = _ssz_type(ns, fork, handler)
+    data = ctx.read("serialized.ssz")
+    expected = ctx.json("root.json")
+    value = cls.decode(data)
+    if cls.encode(value) != data:
+        raise ConformanceError(f"{ctx.path}: ssz round-trip mismatch")
+    root = value.tree_root() if hasattr(value, "tree_root") else cls.hash_tree_root(value)
+    if root.hex() != expected["root"]:
+        raise ConformanceError(
+            f"{ctx.path}: root {root.hex()} != {expected['root']}"
+        )
+
+
+def case_shuffling(ctx: CaseContext, config: str, fork: str, handler: str):
+    """Full-list mapping + per-index agreement (cases/shuffling.rs)."""
+    from ..ops.shuffle import compute_shuffled_index, shuffle_list
+    from ..types.spec import mainnet_spec, minimal_spec
+
+    spec = minimal_spec() if config == "minimal" else mainnet_spec()
+    data = ctx.json("mapping.json")
+    seed = bytes.fromhex(data["seed"])
+    count = data["count"]
+    expected = data["mapping"]
+    rounds = spec.preset.SHUFFLE_ROUND_COUNT
+    got = np.asarray(
+        shuffle_list(np.arange(count, dtype=np.uint64), seed, rounds)
+    ).tolist()
+    if got != expected:
+        raise ConformanceError(f"{ctx.path}: shuffle_list mismatch")
+    for i in range(count):
+        j = compute_shuffled_index(i, count, seed, rounds)
+        if expected[j] != i:
+            raise ConformanceError(
+                f"{ctx.path}: compute_shuffled_index({i}) inconsistent"
+            )
+
+
+def _bls_backends():
+    backends = ["oracle", "native"]
+    if os.environ.get("LIGHTHOUSE_CONFORMANCE_TPU"):
+        backends.append("tpu")
+    return backends
+
+
+def case_bls(ctx: CaseContext, config: str, fork: str, handler: str):
+    """BLS families over the seam, run per backend (cases/bls_*.rs; the
+    reference runs its whole EF matrix once per crypto backend)."""
+    from .. import bls
+
+    data = ctx.json("data.json")
+    prev = bls.get_backend()
+    try:
+        for backend in _bls_backends():
+            bls.set_backend(backend)
+            _run_bls_case(handler, data, backend)
+    finally:
+        bls.set_backend(prev)
+
+
+def _run_bls_case(handler: str, data: dict, backend: str):
+    from .. import bls
+
+    def pk(h):
+        return bls.PublicKey.from_bytes(bytes.fromhex(h))
+
+    if handler == "sign":
+        sk = bls.SecretKey.from_bytes(bytes.fromhex(data["input"]["privkey"]))
+        sig = sk.sign(bytes.fromhex(data["input"]["message"]))
+        if sig.serialize().hex() != data["output"]:
+            raise ConformanceError(f"bls/sign [{backend}]: mismatch")
+    elif handler == "verify":
+        ok_expected = data["output"]
+        try:
+            p = pk(data["input"]["pubkey"])
+            sig = bls.Signature.from_bytes(bytes.fromhex(data["input"]["signature"]))
+            ok = sig.verify(p, bytes.fromhex(data["input"]["message"]))
+        except bls.BlsError:
+            ok = False
+        if ok != ok_expected:
+            raise ConformanceError(f"bls/verify [{backend}]: {ok} != {ok_expected}")
+    elif handler == "aggregate":
+        sigs = [
+            bls.Signature.from_bytes(bytes.fromhex(h)) for h in data["input"]
+        ]
+        agg = bls.AggregateSignature.aggregate(sigs)
+        if agg.serialize().hex() != data["output"]:
+            raise ConformanceError(f"bls/aggregate [{backend}]: mismatch")
+    elif handler == "fast_aggregate_verify":
+        ok_expected = data["output"]
+        try:
+            pks = [pk(h) for h in data["input"]["pubkeys"]]
+            agg = bls.AggregateSignature.from_bytes(
+                bytes.fromhex(data["input"]["signature"])
+            )
+            ok = agg.fast_aggregate_verify(
+                bytes.fromhex(data["input"]["message"]), pks
+            )
+        except bls.BlsError:
+            ok = False
+        if ok != ok_expected:
+            raise ConformanceError(
+                f"bls/fast_aggregate_verify [{backend}]: {ok} != {ok_expected}"
+            )
+    elif handler == "batch_verify":
+        sets = []
+        for s in data["input"]["sets"]:
+            sets.append(
+                bls.SignatureSet.multiple_pubkeys(
+                    bls.Signature.from_bytes(bytes.fromhex(s["signature"])),
+                    [pk(h) for h in s["pubkeys"]],
+                    bytes.fromhex(s["message"]),
+                )
+            )
+        ok = bls.verify_signature_sets(sets)
+        if ok != data["output"]:
+            raise ConformanceError(
+                f"bls/batch_verify [{backend}]: {ok} != {data['output']}"
+            )
+    else:
+        raise ConformanceError(f"unknown bls handler {handler}")
+
+
+def _op_attestation(spec, state, op):
+    from ..state_transition.per_block import ConsensusContext, process_attestation
+
+    process_attestation(spec, state, op, 0, ConsensusContext(), verify=True)
+
+
+def _op_exit(spec, state, op):
+    from ..state_transition.per_block import process_exit
+
+    process_exit(spec, state, op, verify=True)
+
+
+def _op_proposer_slashing(spec, state, op):
+    from ..state_transition.per_block import (
+        ConsensusContext,
+        process_proposer_slashing,
+    )
+
+    process_proposer_slashing(spec, state, op, ConsensusContext(), verify=True)
+
+
+def _op_attester_slashing(spec, state, op):
+    from ..state_transition.per_block import process_attester_slashing
+
+    process_attester_slashing(spec, state, op, verify=True)
+
+
+def case_operations(ctx: CaseContext, config: str, fork: str, handler: str):
+    """pre.ssz + <op>.ssz -> post.ssz, or meta.json {"error": true}
+    (cases/operations.rs shape)."""
+    from ..state_transition.per_block import BlockProcessingError
+
+    ns, spec = _ns_and_spec(config, fork)
+    state_cls = _ssz_type(ns, fork, "BeaconState")
+    state = state_cls.decode(ctx.read("pre.ssz"))
+    expect_error = ctx.has("meta.json") and ctx.json("meta.json").get("error")
+
+    op_files = {
+        "attestation": ("attestation.ssz", ns.Attestation, _op_attestation),
+        "voluntary_exit": (
+            "voluntary_exit.ssz",
+            _ssz_type(ns, fork, "SignedVoluntaryExit"),
+            _op_exit,
+        ),
+        "proposer_slashing": (
+            "proposer_slashing.ssz",
+            _ssz_type(ns, fork, "ProposerSlashing"),
+            _op_proposer_slashing,
+        ),
+        "attester_slashing": (
+            "attester_slashing.ssz",
+            ns.AttesterSlashing,
+            _op_attester_slashing,
+        ),
+    }
+    fname, op_cls, op_fn = op_files[handler]
+    op = op_cls.decode(ctx.read(fname))
+    try:
+        op_fn(spec, state, op)
+        failed = False
+    except BlockProcessingError:
+        failed = True
+    if expect_error:
+        if not failed:
+            raise ConformanceError(f"{ctx.path}: expected rejection, op applied")
+        return
+    if failed:
+        raise ConformanceError(f"{ctx.path}: valid operation rejected")
+    post = state_cls.decode(ctx.read("post.ssz"))
+    if state.tree_root() != post.tree_root():
+        raise ConformanceError(f"{ctx.path}: post-state root mismatch")
+
+
+def case_epoch_processing(ctx: CaseContext, config: str, fork: str, handler: str):
+    """pre.ssz -> process_epoch -> post.ssz (cases/epoch_processing.rs, fused
+    single-pass instead of per-sub-transition)."""
+    from ..state_transition import process_epoch
+
+    ns, spec = _ns_and_spec(config, fork)
+    state_cls = _ssz_type(ns, fork, "BeaconState")
+    state = state_cls.decode(ctx.read("pre.ssz"))
+    process_epoch(spec, state)
+    post = state_cls.decode(ctx.read("post.ssz"))
+    if state.tree_root() != post.tree_root():
+        raise ConformanceError(f"{ctx.path}: epoch post-state mismatch")
+
+
+def case_sanity_blocks(ctx: CaseContext, config: str, fork: str, handler: str):
+    """pre.ssz + blocks_N.ssz... -> post.ssz with full signature verification
+    (cases/sanity_blocks.rs)."""
+    from ..state_transition import BlockSignatureStrategy, per_block_processing, process_slots
+
+    ns, spec = _ns_and_spec(config, fork)
+    state_cls = _ssz_type(ns, fork, "BeaconState")
+    block_cls = _ssz_type(ns, fork, "SignedBeaconBlock")
+    state = state_cls.decode(ctx.read("pre.ssz"))
+    i = 0
+    while ctx.has(f"blocks_{i}.ssz"):
+        sb = block_cls.decode(ctx.read(f"blocks_{i}.ssz"))
+        if state.slot < sb.message.slot:
+            process_slots(spec, state, sb.message.slot)
+        per_block_processing(
+            spec, state, sb, strategy=BlockSignatureStrategy.VERIFY_BULK
+        )
+        i += 1
+    post = state_cls.decode(ctx.read("post.ssz"))
+    if state.tree_root() != post.tree_root():
+        raise ConformanceError(f"{ctx.path}: sanity post-state mismatch")
+
+
+_RUNNERS = {
+    "ssz_static": case_ssz_static,
+    "shuffling": case_shuffling,
+    "bls": case_bls,
+    "operations": case_operations,
+    "epoch_processing": case_epoch_processing,
+    "sanity_blocks": case_sanity_blocks,
+}
+
+
+# ---------------------------------------------------------------------------
+# Walker
+# ---------------------------------------------------------------------------
+
+
+def default_vector_root() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "tests", "vectors")
+
+
+def run_all(root: str | None = None, runners: list[str] | None = None) -> int:
+    """Run every case under root; fail on any unconsumed file. Returns the
+    number of cases run."""
+    root = root or default_vector_root()
+    if not os.path.isdir(root):
+        raise ConformanceError(f"no vector tree at {root} (run generate.py)")
+    consumed: set = set()
+    n_cases = 0
+
+    def _subdirs(path):
+        # stray FILES at intermediate levels are left unconsumed on purpose:
+        # the all-files-consumed check below reports them with a clean error
+        return sorted(
+            e for e in os.listdir(path) if os.path.isdir(os.path.join(path, e))
+        )
+
+    for config in _subdirs(root):
+        for fork in _subdirs(os.path.join(root, config)):
+            fork_dir = os.path.join(root, config, fork)
+            for runner in _subdirs(fork_dir):
+                if runners and runner not in runners:
+                    raise ConformanceError(
+                        f"runner {runner} present on disk but not requested — "
+                        "all vectors must be consumed"
+                    )
+                fn = _RUNNERS.get(runner)
+                if fn is None:
+                    raise ConformanceError(f"no case impl for runner {runner!r}")
+                runner_dir = os.path.join(fork_dir, runner)
+                for handler in _subdirs(runner_dir):
+                    handler_dir = os.path.join(runner_dir, handler)
+                    for case in _subdirs(handler_dir):
+                        ctx = CaseContext(
+                            os.path.join(handler_dir, case), consumed
+                        )
+                        fn(ctx, config, fork, handler)
+                        n_cases += 1
+    # all-files-consumed check
+    all_files = set()
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            all_files.add(os.path.abspath(os.path.join(dirpath, f)))
+    missed = all_files - consumed
+    if missed:
+        listing = "\n  ".join(sorted(missed)[:20])
+        raise ConformanceError(
+            f"{len(missed)} vector file(s) never consumed:\n  {listing}"
+        )
+    return n_cases

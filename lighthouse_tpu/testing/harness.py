@@ -43,6 +43,15 @@ class StateHarness:
 
         self._nb = NativeBls()
 
+    @staticmethod
+    def head_root(state) -> bytes:
+        """Canonical block root of the state's head: the latest block header
+        with its zero state_root filled in (the pre-process_slot form)."""
+        hdr = state.latest_block_header.copy()
+        if bytes(hdr.state_root) == b"\x00" * 32:
+            hdr.state_root = state.tree_root()
+        return hdr.tree_root()
+
     # -- signing helpers ----------------------------------------------------------
 
     def _sign(self, sk_index: int, signing_root: bytes) -> bytes:
@@ -259,10 +268,7 @@ class StateHarness:
                 # attest to the previous slot's head from the pre-state; the
                 # true block root needs the header's state_root filled in
                 prev = self.state
-                hdr = prev.latest_block_header.copy()
-                if bytes(hdr.state_root) == b"\x00" * 32:
-                    hdr.state_root = prev.tree_root()
-                head_root = hdr.tree_root()
+                head_root = self.head_root(prev)
                 att_slot = prev.slot
                 if att_slot + self.spec.min_attestation_inclusion_delay <= slot:
                     atts = self.attestations_for_slot(prev, att_slot, head_root)
