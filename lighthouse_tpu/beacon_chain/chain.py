@@ -56,11 +56,13 @@ class BeaconChain:
         genesis_state,
         store: HotColdDB | None = None,
         slot_clock: SlotClock | None = None,
+        execution_layer=None,
     ):
         self.spec = spec
         self.ns = for_preset(spec.preset.name)
         self.store = store or HotColdDB()
         self.slot_clock = slot_clock or ManualSlotClock(0)
+        self.execution_layer = execution_layer
         self.pubkey_cache = ValidatorPubkeyCache()
         self.pubkey_cache.import_new_pubkeys(genesis_state)
 
@@ -128,11 +130,34 @@ class BeaconChain:
             )
         except (BlockProcessingError, bls.BlsError) as e:
             raise BlockError(str(e)) from None
+        execution_status = self._notify_execution_layer(signed_block)
         self._import_block(
             signed_block, block_root, state, ctxt,
             is_first_block_in_slot=is_first_block_in_slot,
+            execution_status=execution_status,
         )
         return block_root
+
+    def _notify_execution_layer(self, signed_block):
+        """engine_newPayload for merge-era blocks; maps the EL verdict onto
+        fork choice's optimistic-sync statuses (block_verification.rs
+        ExecutionPendingBlock -> payload_verification_status)."""
+        from ..state_transition.per_block import payload_is_default
+
+        payload = getattr(signed_block.message.body, "execution_payload", None)
+        if payload is None or payload_is_default(payload):
+            # pre-merge block (or pre-bellatrix fork): nothing to verify
+            return ExecutionStatus.IRRELEVANT
+        if self.execution_layer is None:
+            return ExecutionStatus.OPTIMISTIC
+        from ..execution_layer import PayloadStatus
+
+        st = self.execution_layer.notify_new_payload(payload)
+        if st.status == PayloadStatus.VALID:
+            return ExecutionStatus.VALID
+        if st.status in (PayloadStatus.SYNCING, PayloadStatus.ACCEPTED):
+            return ExecutionStatus.OPTIMISTIC
+        raise BlockError(f"execution payload invalid: {st.validation_error}")
 
     def process_chain_segment(self, blocks) -> list:
         """Batch-verify ALL signatures of a segment in one bls call, then
@@ -150,27 +175,33 @@ class BeaconChain:
         state = self.get_state_for_block(bytes(first.parent_root), first.slot)
         all_sets = []
         prepared = []
-        for sb in blocks:
-            block = sb.message
-            if state.slot < block.slot:
-                process_slots(self.spec, state, block.slot)
-            v = BlockSignatureVerifier(self.spec, state, self.pubkey_cache.get)
-            ctxt = ConsensusContext()
-            ctxt.get_pubkey_index = self.pubkey_cache.get_index
-            v.include_all_signatures(sb, ctxt)
-            all_sets.extend(v.sets)
-            per_block_processing(
-                self.spec, state, sb,
-                strategy=BlockSignatureStrategy.NO_VERIFICATION,
-                ctxt=ctxt,
-            )
-            prepared.append((sb, state.copy(), ctxt))
+        try:
+            for sb in blocks:
+                block = sb.message
+                if state.slot < block.slot:
+                    process_slots(self.spec, state, block.slot)
+                v = BlockSignatureVerifier(self.spec, state, self.pubkey_cache.get)
+                ctxt = ConsensusContext()
+                ctxt.get_pubkey_index = self.pubkey_cache.get_index
+                v.include_all_signatures(sb, ctxt)
+                all_sets.extend(v.sets)
+                per_block_processing(
+                    self.spec, state, sb,
+                    strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                    ctxt=ctxt,
+                )
+                prepared.append((sb, state.copy(), ctxt))
+        except (BlockProcessingError, bls.BlsError) as e:
+            raise BlockError(str(e)) from None
         if not bls.verify_signature_sets(all_sets):
             raise BlockError("chain segment signature verification failed")
         for sb, post_state, ctxt in prepared:
             block = sb.message
             root = type(block).hash_tree_root(block)
-            self._import_block(sb, root, post_state, ctxt)
+            self._import_block(
+                sb, root, post_state, ctxt,
+                execution_status=self._notify_execution_layer(sb),
+            )
             roots.append(root)
         return roots
 
@@ -192,6 +223,7 @@ class BeaconChain:
     def _import_block(
         self, signed_block, block_root, state, ctxt,
         is_first_block_in_slot: bool = True,
+        execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
     ) -> None:
         block = signed_block.message
         self.pubkey_cache.import_new_pubkeys(state)
@@ -210,7 +242,7 @@ class BeaconChain:
             justified_balances=self._justified_balances(
                 bytes(state.current_justified_checkpoint.root), state
             ),
-            execution_status=ExecutionStatus.IRRELEVANT,
+            execution_status=execution_status,
             is_first_block_in_slot=is_first_block_in_slot,
         )
         # apply the block's attestations to fork choice (import_block does)

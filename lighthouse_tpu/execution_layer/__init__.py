@@ -1,0 +1,19 @@
+"""Execution layer — the engine-API seam and its mock implementation.
+
+Twin of ``/root/reference/beacon_node/execution_layer``: the beacon chain
+talks to an execution client through three engine methods
+(``engine_newPayload`` / ``engine_forkchoiceUpdated`` / ``engine_getPayload``,
+``execution_layer/src/engine_api/mod.rs``), and ships a full in-process mock
+(``execution_layer/src/test_utils/mock_execution_layer.rs`` +
+``ExecutionBlockGenerator``) so merge-era blocks import without a real EL.
+The HTTP JSON-RPC transport for a real client plugs in behind the same
+``ExecutionEngine`` interface.
+"""
+
+from .engine import (  # noqa: F401
+    ExecutionEngine,
+    PayloadAttributes,
+    PayloadStatus,
+    PayloadStatusV1,
+)
+from .mock import ExecutionBlockGenerator, MockExecutionLayer  # noqa: F401

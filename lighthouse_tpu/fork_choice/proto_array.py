@@ -106,6 +106,10 @@ class ProtoArrayForkChoice:
 
     # -- block insertion (proto_array.rs on_block) ------------------------------
 
+    def get_node(self, root: bytes):
+        idx = self.indices.get(root)
+        return self.nodes[idx] if idx is not None else None
+
     def on_block(
         self,
         slot: int,

@@ -97,6 +97,18 @@ def interop_genesis_state(
         sc = get_next_sync_committee(spec, state)
         state.current_sync_committee = sc
         state.next_sync_committee = get_next_sync_committee(spec, state)
+    if fork_name in ("bellatrix", "capella", "deneb", "electra"):
+        # post-merge interop genesis: the execution chain starts at the mock
+        # EL's genesis block so payload parent hashes link up
+        # (interop.rs + mock_execution_layer genesis wiring)
+        from ..execution_layer.mock import GENESIS_BLOCK_HASH
+
+        hdr_cls = ns.payload_header_types[fork_name]
+        state.latest_execution_payload_header = hdr_cls(
+            block_hash=GENESIS_BLOCK_HASH,
+            timestamp=genesis_time,
+            prev_randao=ETH1_BLOCK_HASH,
+        )
     return state
 
 

@@ -129,6 +129,7 @@ class BlockSignatureVerifier:
         self.include_attestations(block, ctxt)
         self.include_exits(block)
         self.include_sync_aggregate(block)
+        self.include_bls_to_execution_changes(block)
 
     def include_block_proposal(self, signed_block):
         self.sets.append(
@@ -177,6 +178,14 @@ class BlockSignatureVerifier:
         for ex in block.body.voluntary_exits:
             self.sets.append(
                 sigs.exit_signature_set(self.spec, self.state, ex, self.get_pubkey)
+            )
+
+    def include_bls_to_execution_changes(self, block):
+        for ch in getattr(block.body, "bls_to_execution_changes", []):
+            self.sets.append(
+                sigs.bls_to_execution_change_signature_set(
+                    self.spec, self.state, ch
+                )
             )
 
     def include_sync_aggregate(self, block):
@@ -258,6 +267,15 @@ def per_block_processing(
         inner = "none"
 
     process_block_header(spec, state, block, ctxt)
+    fork = getattr(state, "fork_name", "phase0")
+    payload = getattr(block.body, "execution_payload", None)
+    if payload is not None and is_execution_enabled(state, payload):
+        if fork in ("capella", "deneb", "electra"):
+            process_withdrawals(spec, state, payload)
+        # EL notify_new_payload happens at the chain layer
+        # (block_verification.rs ExecutionPendingBlock); here only the
+        # consensus-consistency checks + header update run.
+        process_execution_payload(spec, state, payload)
     process_randao(spec, state, block, verify=(inner in ("individual", "randao")))
     process_eth1_data(spec, state, block.body)
     process_operations(spec, state, block.body, ctxt, verify=(inner == "individual"))
@@ -353,6 +371,180 @@ def process_operations(spec, state, body, ctxt: ConsensusContext, verify: bool):
         process_deposit(spec, state, dep, ctxt)
     for ex in body.voluntary_exits:
         process_exit(spec, state, ex, verify)
+    for change in getattr(body, "bls_to_execution_changes", []):
+        process_bls_to_execution_change(spec, state, change, verify)
+
+
+# -- execution payloads (bellatrix+) ---------------------------------------------
+
+
+def is_merge_transition_complete(state) -> bool:
+    hdr = getattr(state, "latest_execution_payload_header", None)
+    if hdr is None:
+        return False
+    return hdr.tree_root() != type(hdr)().tree_root()
+
+
+def payload_is_default(payload) -> bool:
+    return type(payload).encode(payload) == type(payload).encode(type(payload)())
+
+
+def is_execution_enabled(state, payload) -> bool:
+    """Bellatrix is_execution_enabled: post-merge, or this IS the merge
+    transition block (non-default payload on a pre-merge state)."""
+    return is_merge_transition_complete(state) or not payload_is_default(payload)
+
+
+def compute_timestamp_at_slot(spec, state, slot: int) -> int:
+    return int(state.genesis_time) + slot * spec.preset.SECONDS_PER_SLOT
+
+
+def process_execution_payload(spec, state, payload) -> None:
+    """Consensus-side payload checks + header update (bellatrix
+    process_execution_payload minus the engine call, which the chain layer
+    performs — the reference's split between per_block_processing.rs:100 and
+    block_verification.rs ExecutionPendingBlock)."""
+    from .beacon_state_util import get_current_epoch, get_randao_mix
+
+    if is_merge_transition_complete(state):
+        if bytes(payload.parent_hash) != bytes(
+            state.latest_execution_payload_header.block_hash
+        ):
+            raise BlockProcessingError("payload parent hash mismatch")
+    if bytes(payload.prev_randao) != get_randao_mix(
+        spec, state, get_current_epoch(spec, state)
+    ):
+        raise BlockProcessingError("payload prev_randao mismatch")
+    if int(payload.timestamp) != compute_timestamp_at_slot(spec, state, state.slot):
+        raise BlockProcessingError("payload timestamp mismatch")
+
+    from ..types.containers import for_preset
+    from ..ssz import List as SSZList
+
+    ns = for_preset(spec.preset.name)
+    fork = getattr(state, "fork_name", "bellatrix")
+    hdr_cls = ns.payload_header_types[fork]
+    payload_cls = ns.payload_types[fork]
+    tx_type = dict(payload_cls.FIELDS)["transactions"]
+    fields = {
+        n: getattr(payload, n)
+        for n, _ in payload_cls.FIELDS
+        if n not in ("transactions", "withdrawals")
+    }
+    fields["transactions_root"] = tx_type.hash_tree_root(payload.transactions)
+    if hasattr(payload, "withdrawals"):
+        w_type = dict(payload_cls.FIELDS)["withdrawals"]
+        fields["withdrawals_root"] = w_type.hash_tree_root(payload.withdrawals)
+    state.latest_execution_payload_header = hdr_cls(**fields)
+
+
+# -- withdrawals (capella+) --------------------------------------------------------
+
+
+def has_eth1_withdrawal_credential(validator) -> bool:
+    return bytes(validator.withdrawal_credentials)[:1] == b"\x01"
+
+
+def is_fully_withdrawable_validator(validator, balance: int, epoch: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.withdrawable_epoch <= epoch
+        and balance > 0
+    )
+
+
+def is_partially_withdrawable_validator(spec, validator, balance: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.effective_balance == spec.max_effective_balance
+        and balance > spec.max_effective_balance
+    )
+
+
+def get_expected_withdrawals(spec, state) -> list:
+    """Capella withdrawal sweep (get_expected_withdrawals)."""
+    from ..types.containers import Withdrawal
+    from .beacon_state_util import get_current_epoch
+
+    epoch = get_current_epoch(spec, state)
+    widx = int(state.next_withdrawal_index)
+    vidx = int(state.next_withdrawal_validator_index)
+    n = len(state.validators)
+    out = []
+    for _ in range(min(n, spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
+        v = state.validators[vidx]
+        balance = int(state.balances[vidx])
+        address = bytes(v.withdrawal_credentials)[12:]
+        if is_fully_withdrawable_validator(v, balance, epoch):
+            out.append(
+                Withdrawal(
+                    index=widx, validator_index=vidx, address=address,
+                    amount=balance,
+                )
+            )
+            widx += 1
+        elif is_partially_withdrawable_validator(spec, v, balance):
+            out.append(
+                Withdrawal(
+                    index=widx, validator_index=vidx, address=address,
+                    amount=balance - spec.max_effective_balance,
+                )
+            )
+            widx += 1
+        if len(out) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        vidx = (vidx + 1) % n
+    return out
+
+
+def process_withdrawals(spec, state, payload) -> None:
+    from .common import decrease_balance
+
+    expected = get_expected_withdrawals(spec, state)
+    got = list(payload.withdrawals)
+    if len(got) != len(expected) or any(
+        type(a).encode(a) != type(b).encode(b) for a, b in zip(got, expected)
+    ):
+        raise BlockProcessingError("payload withdrawals != expected sweep")
+    for w in expected:
+        decrease_balance(state, int(w.validator_index), int(w.amount))
+    n = len(state.validators)
+    if expected:
+        state.next_withdrawal_index = int(expected[-1].index) + 1
+    if len(expected) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
+        state.next_withdrawal_validator_index = (
+            int(expected[-1].validator_index) + 1
+        ) % n
+    else:
+        state.next_withdrawal_validator_index = (
+            int(state.next_withdrawal_validator_index)
+            + spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+        ) % n
+
+
+def process_bls_to_execution_change(spec, state, signed_change, verify: bool):
+    """Capella BLS->execution credential rotation. Signature semantics
+    (GENESIS fork domain) live in the shared set constructor
+    (signature_sets.bls_to_execution_change_signature_set)."""
+    import hashlib as _hashlib
+
+    msg = signed_change.message
+    idx = int(msg.validator_index)
+    if idx >= len(state.validators):
+        raise BlockProcessingError("bls change: unknown validator")
+    v = state.validators[idx]
+    creds = bytes(v.withdrawal_credentials)
+    if creds[:1] != b"\x00":
+        raise BlockProcessingError("bls change: not a BLS credential")
+    if creds[1:] != _hashlib.sha256(bytes(msg.from_bls_pubkey)).digest()[1:]:
+        raise BlockProcessingError("bls change: pubkey does not match credential")
+    if verify:
+        s = sigs.bls_to_execution_change_signature_set(spec, state, signed_change)
+        if not bls.verify_signature_sets([s]):
+            raise BlockProcessingError("bls change: invalid signature")
+    v.withdrawal_credentials = (
+        b"\x01" + b"\x00" * 11 + bytes(msg.to_execution_address)
+    )
 
 
 def process_proposer_slashing(spec, state, slashing, ctxt, verify: bool):

@@ -382,6 +382,20 @@ def process_historical_roots_update(spec, state):
         from ..types.containers import for_preset
 
         ns = for_preset(spec.preset.name)
+        if getattr(state, "fork_name", "phase0") in ("capella", "deneb", "electra"):
+            # capella: accumulate summaries instead of batch roots
+            from ..types.containers import HistoricalSummary
+            from ..ssz import Vector
+            from ..types.containers import Root
+
+            br = Vector(Root, p.SLOTS_PER_HISTORICAL_ROOT)
+            state.historical_summaries = list(state.historical_summaries) + [
+                HistoricalSummary(
+                    block_summary_root=br.hash_tree_root(list(state.block_roots)),
+                    state_summary_root=br.hash_tree_root(list(state.state_roots)),
+                )
+            ]
+            return
         batch = ns.HistoricalBatch(
             block_roots=list(state.block_roots),
             state_roots=list(state.state_roots),

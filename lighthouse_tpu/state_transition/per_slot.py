@@ -25,6 +25,8 @@ def per_slot_processing(
     know the root (state_advance.rs does the same)."""
     from .per_epoch import process_epoch
 
+    from .upgrades import apply_fork_upgrades
+
     process_slot(spec, state, state_root)
     epoch_boundary = (state.slot + 1) % spec.preset.SLOTS_PER_EPOCH == 0
     if epoch_boundary:
@@ -34,6 +36,8 @@ def per_slot_processing(
         # committee caches are per-epoch; they stay valid within an epoch
         # (the reference keeps prev/cur/next caches across slots)
         invalidate_caches(state)
+        # fork upgrades fire exactly when the boundary enters the fork epoch
+        apply_fork_upgrades(spec, state)
 
 
 def process_slots(spec: ChainSpec, state, target_slot: int) -> None:

@@ -15,8 +15,9 @@ from ..types.spec import ChainSpec
 from .beacon_state_util import get_indexed_attestation
 
 
-class SignatureSetError(Exception):
-    pass
+class SignatureSetError(bls.BlsError):
+    """Set construction failed on untrusted input (subclasses BlsError so the
+    chain's block-rejection handling catches it as a clean BlockError)."""
 
 
 def _pubkey(get_pubkey, state, index: int) -> bls.PublicKey:
@@ -120,6 +121,29 @@ def exit_signature_set(
         bls.Signature.from_bytes(bytes(signed_exit.signature)),
         _pubkey(get_pubkey, state, exit_msg.validator_index),
         root,
+    )
+
+
+def bls_to_execution_change_signature_set(
+    spec: ChainSpec, state, signed_change
+) -> bls.SignatureSet:
+    """Capella credential rotation: signed by the OLD BLS key under the
+    GENESIS fork domain (signature_sets.rs bls_execution_change_signature_set)."""
+    from ..types.helpers import compute_domain
+
+    msg = signed_change.message
+    domain = compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        spec.genesis_fork_version,
+        bytes(state.genesis_validators_root),
+    )
+    root = compute_signing_root(msg, domain)
+    try:
+        pk = bls.PublicKey.from_bytes(bytes(msg.from_bls_pubkey))
+    except bls.BlsError as e:
+        raise SignatureSetError(str(e)) from None
+    return bls.SignatureSet.single_pubkey(
+        bls.Signature.from_bytes(bytes(signed_change.signature)), pk, root
     )
 
 

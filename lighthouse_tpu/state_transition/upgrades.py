@@ -1,0 +1,123 @@
+"""Fork-boundary state upgrades (bellatrix, capella).
+
+Twin of ``consensus/state_processing/src/upgrade/{bellatrix,capella}.rs``.
+Upgrades mutate IN PLACE by swapping the container class and adding the new
+fork's fields — every holder of the state reference sees the upgraded state,
+matching the mutate-in-place convention of the rest of the transition code.
+"""
+
+from __future__ import annotations
+
+from ..types.containers import Fork, for_preset
+from ..types.spec import ChainSpec
+from .beacon_state_util import get_current_epoch, invalidate_caches
+
+
+def upgrade_to_altair(spec: ChainSpec, state) -> None:
+    """phase0 -> altair: participation flags + sync committees; previous-epoch
+    pending attestations are translated into participation flags
+    (upgrade/altair.rs translate_participation)."""
+    import numpy as np
+
+    ns = for_preset(spec.preset.name)
+    epoch = get_current_epoch(spec, state)
+    n = len(state.validators)
+    pending = list(state.previous_epoch_attestations)
+
+    state.fork = Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=spec.altair_fork_version,
+        epoch=epoch,
+    )
+    del state.previous_epoch_attestations
+    del state.current_epoch_attestations
+    state.__class__ = ns.BeaconStateAltair
+    state.previous_epoch_participation = np.zeros(n, np.uint8)
+    state.current_epoch_participation = np.zeros(n, np.uint8)
+    state.inactivity_scores = np.zeros(n, np.uint64)
+    invalidate_caches(state)
+
+    # translate_participation: replay pending attestations as flag sets
+    from .beacon_state_util import get_beacon_committee
+    from .per_block import get_attestation_participation_flag_indices
+
+    for att in pending:
+        try:
+            flag_indices = get_attestation_participation_flag_indices(
+                spec, state, att.data, int(att.inclusion_delay)
+            )
+        except Exception:
+            continue  # source no longer matches after the boundary: no flags
+        committee = get_beacon_committee(
+            spec, state, int(att.data.slot), int(att.data.index)
+        )
+        bits = np.asarray(att.aggregation_bits, dtype=bool)
+        for pos, vi in enumerate(committee):
+            if pos < len(bits) and bits[pos]:
+                for fi in flag_indices:
+                    state.previous_epoch_participation[int(vi)] |= np.uint8(1 << fi)
+
+    from .per_epoch import get_next_sync_committee
+
+    state.current_sync_committee = get_next_sync_committee(spec, state)
+    state.next_sync_committee = get_next_sync_committee(spec, state)
+
+
+def upgrade_to_bellatrix(spec: ChainSpec, state) -> None:
+    """altair -> bellatrix: default execution payload header (pre-merge)."""
+    ns = for_preset(spec.preset.name)
+    epoch = get_current_epoch(spec, state)
+    state.fork = Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=spec.bellatrix_fork_version,
+        epoch=epoch,
+    )
+    state.__class__ = ns.BeaconStateBellatrix
+    state.latest_execution_payload_header = ns.ExecutionPayloadHeaderBellatrix()
+    invalidate_caches(state)
+
+
+def upgrade_to_capella(spec: ChainSpec, state) -> None:
+    """bellatrix -> capella: withdrawals bookkeeping + header gains
+    withdrawals_root + historical accumulation switches to summaries."""
+    ns = for_preset(spec.preset.name)
+    epoch = get_current_epoch(spec, state)
+    state.fork = Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=spec.capella_fork_version,
+        epoch=epoch,
+    )
+    old = state.latest_execution_payload_header
+    new_hdr = ns.ExecutionPayloadHeaderCapella(
+        **{n: getattr(old, n) for n, _ in type(old).FIELDS}
+    )
+    state.__class__ = ns.BeaconStateCapella
+    state.latest_execution_payload_header = new_hdr
+    state.next_withdrawal_index = 0
+    state.next_withdrawal_validator_index = 0
+    state.historical_summaries = []
+    invalidate_caches(state)
+
+
+UPGRADES = {
+    "altair": upgrade_to_altair,
+    "bellatrix": upgrade_to_bellatrix,
+    "capella": upgrade_to_capella,
+}
+
+_FORK_RANK = {f: i for i, f in enumerate(["phase0", *UPGRADES])}
+
+
+def apply_fork_upgrades(spec: ChainSpec, state) -> None:
+    """Run any upgrade scheduled exactly at the state's current epoch
+    (called by process_slots right after crossing an epoch boundary).
+    Upgrades apply strictly in fork order from the state's CURRENT fork, so a
+    later upgrade can never fire on a state missing earlier forks' fields."""
+    epoch = get_current_epoch(spec, state)
+    for fork, fn in UPGRADES.items():
+        if (
+            spec.fork_epoch(fork) == epoch
+            and _FORK_RANK[getattr(state, "fork_name", "phase0")]
+            == _FORK_RANK[fork] - 1
+        ):
+            fn(spec, state)

@@ -42,6 +42,10 @@ class StateHarness:
         from ..native.build import NativeBls
 
         self._nb = NativeBls()
+        # mock execution layer for merge-era forks (test_utils.rs:508-524)
+        from ..execution_layer import MockExecutionLayer
+
+        self.el = MockExecutionLayer()
 
     @staticmethod
     def head_root(state) -> bytes:
@@ -203,6 +207,8 @@ class StateHarness:
         )
         if fork != "phase0":
             body.sync_aggregate = self._sync_aggregate(state, slot)
+        if fork in ("bellatrix", "capella", "deneb", "electra"):
+            body.execution_payload = self._execution_payload(state, slot, fork)
         inner_cls = dict(block_cls.FIELDS)["message"]
         block = inner_cls(
             slot=slot,
@@ -224,6 +230,62 @@ class StateHarness:
         domain = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=epoch)
         sig = self._sign(proposer, compute_signing_root(block, domain))
         return block_cls(message=block, signature=sig)
+
+    def resign_block(self, signed_block):
+        """Recompute state_root + proposer signature after mutating a
+        produced block's body (test-only convenience)."""
+        block = signed_block.message
+        spec = self.spec
+        state = self.state.copy()
+        if state.slot < block.slot:
+            process_slots(spec, state, block.slot)
+        trial = state.copy()
+        block.state_root = b"\x00" * 32
+        per_block_processing(
+            spec, trial, type(signed_block)(message=block, signature=b"\x00" * 96),
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            verify_block_root=False,
+        )
+        block.state_root = trial.tree_root()
+        epoch = get_current_epoch(spec, state)
+        domain = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=epoch)
+        sig = self._sign(
+            int(block.proposer_index), compute_signing_root(block, domain)
+        )
+        return type(signed_block)(message=block, signature=sig)
+
+    def _execution_payload(self, state, slot: int, fork: str):
+        """Build the next mock execution payload on the state's payload head
+        (MockExecutionLayer/ExecutionBlockGenerator parity)."""
+        from ..state_transition import get_current_epoch, get_randao_mix
+        from ..state_transition.per_block import (
+            compute_timestamp_at_slot,
+            get_expected_withdrawals,
+        )
+
+        from ..execution_layer.mock import GENESIS_BLOCK_HASH
+        from ..state_transition.per_block import is_merge_transition_complete
+
+        payload_cls = self.ns.payload_types[fork]
+        withdrawals = None
+        if fork in ("capella", "deneb", "electra"):
+            withdrawals = get_expected_withdrawals(self.spec, state)
+        # pre-merge bellatrix state: this block IS the merge transition —
+        # build the first payload on the mock EL's genesis block
+        parent_hash = (
+            bytes(state.latest_execution_payload_header.block_hash)
+            if is_merge_transition_complete(state)
+            else GENESIS_BLOCK_HASH
+        )
+        return self.el.generator.produce_payload(
+            payload_cls,
+            parent_hash=parent_hash,
+            timestamp=compute_timestamp_at_slot(self.spec, state, slot),
+            prev_randao=get_randao_mix(
+                self.spec, state, get_current_epoch(self.spec, state)
+            ),
+            withdrawals=withdrawals,
+        )
 
     def _sync_aggregate(self, state, slot: int):
         spec = self.spec

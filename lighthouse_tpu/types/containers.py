@@ -107,6 +107,40 @@ class DepositData(Container):
     ]
 
 
+class Withdrawal(Container):
+    FIELDS = [
+        ("index", uint64),
+        ("validator_index", ValidatorIndex),
+        ("address", Bytes20),
+        ("amount", Gwei),
+    ]
+
+
+class BLSToExecutionChange(Container):
+    FIELDS = [
+        ("validator_index", ValidatorIndex),
+        ("from_bls_pubkey", BLSPubkey),
+        ("to_execution_address", Bytes20),
+    ]
+
+
+class SignedBLSToExecutionChange(Container):
+    FIELDS = [
+        ("message", BLSToExecutionChange),
+        ("signature", BLSSignature),
+    ]
+
+
+class HistoricalSummary(Container):
+    """Capella replacement for HistoricalBatch accumulation
+    (consensus/types/src/historical_summary.rs)."""
+
+    FIELDS = [
+        ("block_summary_root", Root),
+        ("state_summary_root", Root),
+    ]
+
+
 class BeaconBlockHeader(Container):
     FIELDS = [
         ("slot", Slot),
@@ -305,6 +339,104 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         )
         fork_name = "altair"
 
+    # -- bellatrix / capella variants (execution payloads) -------------------
+
+    Transaction = ByteList(p.MAX_BYTES_PER_TRANSACTION)
+
+    _payload_common = [
+        ("parent_hash", Hash32),
+        ("fee_recipient", Bytes20),
+        ("state_root", Root),
+        ("receipts_root", Root),
+        ("logs_bloom", ByteVector(p.BYTES_PER_LOGS_BLOOM)),
+        ("prev_randao", Hash32),
+        ("block_number", uint64),
+        ("gas_limit", uint64),
+        ("gas_used", uint64),
+        ("timestamp", uint64),
+        ("extra_data", ByteList(p.MAX_EXTRA_DATA_BYTES)),
+        ("base_fee_per_gas", uint256),
+        ("block_hash", Hash32),
+    ]
+
+    class ExecutionPayloadBellatrix(Container):
+        FIELDS = _payload_common + [
+            ("transactions", List(Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD)),
+        ]
+
+    class ExecutionPayloadHeaderBellatrix(Container):
+        FIELDS = _payload_common + [("transactions_root", Root)]
+
+    class ExecutionPayloadCapella(Container):
+        FIELDS = ExecutionPayloadBellatrix.FIELDS + [
+            ("withdrawals", List(Withdrawal, p.MAX_WITHDRAWALS_PER_PAYLOAD)),
+        ]
+
+    class ExecutionPayloadHeaderCapella(Container):
+        FIELDS = ExecutionPayloadHeaderBellatrix.FIELDS + [
+            ("withdrawals_root", Root),
+        ]
+
+    class BeaconBlockBodyBellatrix(Container):
+        FIELDS = BeaconBlockBodyAltair.FIELDS + [
+            ("execution_payload", ExecutionPayloadBellatrix),
+        ]
+
+    class BeaconBlockBellatrix(Container):
+        FIELDS = [
+            ("slot", Slot),
+            ("proposer_index", ValidatorIndex),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BeaconBlockBodyBellatrix),
+        ]
+
+    class SignedBeaconBlockBellatrix(Container):
+        FIELDS = [("message", BeaconBlockBellatrix), ("signature", BLSSignature)]
+
+    class BeaconBlockBodyCapella(Container):
+        FIELDS = [
+            (n, t) if n != "execution_payload" else (n, ExecutionPayloadCapella)
+            for n, t in BeaconBlockBodyBellatrix.FIELDS
+        ] + [
+            (
+                "bls_to_execution_changes",
+                List(SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES),
+            ),
+        ]
+
+    class BeaconBlockCapella(Container):
+        FIELDS = [
+            ("slot", Slot),
+            ("proposer_index", ValidatorIndex),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BeaconBlockBodyCapella),
+        ]
+
+    class SignedBeaconBlockCapella(Container):
+        FIELDS = [("message", BeaconBlockCapella), ("signature", BLSSignature)]
+
+    class BeaconStateBellatrix(Container):
+        FIELDS = BeaconStateAltair.FIELDS + [
+            ("latest_execution_payload_header", ExecutionPayloadHeaderBellatrix),
+        ]
+        fork_name = "bellatrix"
+
+    class BeaconStateCapella(Container):
+        FIELDS = [
+            (n, t)
+            if n != "latest_execution_payload_header"
+            else (n, ExecutionPayloadHeaderCapella)
+            for n, t in BeaconStateBellatrix.FIELDS
+        ] + [
+            ("next_withdrawal_index", uint64),
+            ("next_withdrawal_validator_index", ValidatorIndex),
+            ("historical_summaries",
+             List(HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT)),
+        ]
+        fork_name = "capella"
+
     ns = SimpleNamespace(
         preset=p,
         IndexedAttestation=IndexedAttestation,
@@ -324,9 +456,44 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         BeaconBlockAltair=BeaconBlockAltair,
         SignedBeaconBlockAltair=SignedBeaconBlockAltair,
         BeaconStateAltair=BeaconStateAltair,
+        ExecutionPayloadBellatrix=ExecutionPayloadBellatrix,
+        ExecutionPayloadHeaderBellatrix=ExecutionPayloadHeaderBellatrix,
+        ExecutionPayloadCapella=ExecutionPayloadCapella,
+        ExecutionPayloadHeaderCapella=ExecutionPayloadHeaderCapella,
+        BeaconBlockBodyBellatrix=BeaconBlockBodyBellatrix,
+        BeaconBlockBellatrix=BeaconBlockBellatrix,
+        SignedBeaconBlockBellatrix=SignedBeaconBlockBellatrix,
+        BeaconBlockBodyCapella=BeaconBlockBodyCapella,
+        BeaconBlockCapella=BeaconBlockCapella,
+        SignedBeaconBlockCapella=SignedBeaconBlockCapella,
+        BeaconStateBellatrix=BeaconStateBellatrix,
+        BeaconStateCapella=BeaconStateCapella,
         # fork-indexed lookup used by generic code
-        state_types={"phase0": BeaconState, "altair": BeaconStateAltair},
-        block_types={"phase0": SignedBeaconBlock, "altair": SignedBeaconBlockAltair},
-        body_types={"phase0": BeaconBlockBody, "altair": BeaconBlockBodyAltair},
+        state_types={
+            "phase0": BeaconState,
+            "altair": BeaconStateAltair,
+            "bellatrix": BeaconStateBellatrix,
+            "capella": BeaconStateCapella,
+        },
+        block_types={
+            "phase0": SignedBeaconBlock,
+            "altair": SignedBeaconBlockAltair,
+            "bellatrix": SignedBeaconBlockBellatrix,
+            "capella": SignedBeaconBlockCapella,
+        },
+        body_types={
+            "phase0": BeaconBlockBody,
+            "altair": BeaconBlockBodyAltair,
+            "bellatrix": BeaconBlockBodyBellatrix,
+            "capella": BeaconBlockBodyCapella,
+        },
+        payload_types={
+            "bellatrix": ExecutionPayloadBellatrix,
+            "capella": ExecutionPayloadCapella,
+        },
+        payload_header_types={
+            "bellatrix": ExecutionPayloadHeaderBellatrix,
+            "capella": ExecutionPayloadHeaderCapella,
+        },
     )
     return ns

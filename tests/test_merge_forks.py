@@ -1,0 +1,191 @@
+"""Merge-era forks: bellatrix/capella types, payloads, withdrawals, upgrades.
+
+VERDICT round-1 item 5: ExecutionPayload + withdrawals in containers/spec/
+per-block, a mock execution layer, and payload-status plumbing into fork
+choice's optimistic machinery (refs: consensus/types/src/eth_spec.rs:53-165,
+execution_layer/src/test_utils/mock_execution_layer.rs).
+"""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import BeaconChain, BlockError
+from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def _capella_spec(**kw):
+    return minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0, **kw
+    )
+
+
+def test_capella_genesis_chain_extends():
+    h = StateHarness(_capella_spec(), 16)
+    assert h.state.fork_name == "capella"
+    h.extend_chain(4)
+    assert h.state.slot == 4
+    assert int(h.state.latest_execution_payload_header.block_number) == 4
+
+
+def test_bellatrix_genesis_chain_extends():
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0)
+    h = StateHarness(spec, 16)
+    assert h.state.fork_name == "bellatrix"
+    h.extend_chain(3)
+    assert int(h.state.latest_execution_payload_header.block_number) == 3
+
+
+def test_fork_upgrades_cross_epochs():
+    """altair genesis -> bellatrix at epoch 1 -> capella at epoch 2."""
+    spec = minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=1, capella_fork_epoch=2
+    )
+    h = StateHarness(spec, 16)
+    assert h.state.fork_name == "altair"
+    spe = spec.preset.SLOTS_PER_EPOCH
+    h.extend_chain(spe)  # crosses into epoch 1
+    assert h.state.fork_name == "bellatrix"
+    assert bytes(h.state.fork.current_version) == spec.bellatrix_fork_version
+    h.extend_chain(spe)  # crosses into epoch 2
+    assert h.state.fork_name == "capella"
+    assert h.state.historical_summaries == []
+    h.extend_chain(2)  # capella blocks with payloads apply
+    assert int(h.state.latest_execution_payload_header.block_number) >= 1
+
+
+def test_phase0_to_altair_upgrade():
+    """phase0 genesis crosses the altair fork with participation translated."""
+    spec = minimal_spec(altair_fork_epoch=1)
+    h = StateHarness(spec, 16)
+    assert h.state.fork_name == "phase0"
+    h.extend_chain(spec.preset.SLOTS_PER_EPOCH)
+    assert h.state.fork_name == "altair"
+    assert bytes(h.state.fork.current_version) == spec.altair_fork_version
+    # pending attestations were translated into previous-epoch flags
+    import numpy as np
+
+    assert np.asarray(h.state.previous_epoch_participation).any()
+    h.extend_chain(2)  # altair blocks (sync aggregates) apply
+
+
+def test_withdrawals_sweep_partial():
+    """A validator with eth1 credentials and excess balance gets swept."""
+    h = StateHarness(_capella_spec(), 16)
+    st = h.state
+    st.validators[5].withdrawal_credentials = (
+        b"\x01" + b"\x00" * 11 + b"\xaa" * 20
+    )
+    st.balances[5] = h.spec.max_effective_balance + 7 * 10**9
+    before = int(st.balances[5])
+    h.extend_chain(2)
+    # the 7-ETH excess is withdrawn (follow-up sweeps may take reward crumbs)
+    delta = before - int(h.state.balances[5])
+    assert 7 * 10**9 - 10**7 <= delta <= 7 * 10**9 + 10**7
+    assert int(h.state.next_withdrawal_index) >= 1
+
+
+def test_bls_to_execution_change_applies():
+    h = StateHarness(_capella_spec(), 16)
+    h.extend_chain(1)
+    from lighthouse_tpu.types.containers import (
+        BLSToExecutionChange,
+        SignedBLSToExecutionChange,
+    )
+    from lighthouse_tpu.types.helpers import compute_domain, compute_signing_root
+
+    idx = 7
+    pk_bytes = bytes(h.state.validators[idx].pubkey)
+    msg = BLSToExecutionChange(
+        validator_index=idx,
+        from_bls_pubkey=pk_bytes,
+        to_execution_address=b"\xbb" * 20,
+    )
+    domain = compute_domain(
+        h.spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        h.spec.genesis_fork_version,
+        bytes(h.state.genesis_validators_root),
+    )
+    sig = h._sign(idx, compute_signing_root(msg, domain))
+    change = SignedBLSToExecutionChange(message=msg, signature=sig)
+
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    block.message.body.bls_to_execution_changes = [change]
+    # re-sign after mutating the body
+    block = h.resign_block(block)
+    h.apply_block(block)
+    creds = bytes(h.state.validators[idx].withdrawal_credentials)
+    assert creds[:1] == b"\x01" and creds[12:] == b"\xbb" * 20
+
+
+def test_chain_imports_capella_blocks_with_mock_el():
+    spec = _capella_spec()
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(
+        spec, h.state.copy(), slot_clock=clock, execution_layer=h.el
+    )
+    for slot in (1, 2, 3):
+        clock.set_slot(slot)
+        b = h.produce_block(slot)
+        h.apply_block(b)
+        root = chain.process_block(b)
+        node = chain.fork_choice.proto.get_node(root)
+        assert node.execution_status == ExecutionStatus.VALID
+    assert chain.head.slot == 3
+
+
+def test_invalid_payload_rejected():
+    spec = _capella_spec()
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(
+        spec, h.state.copy(), slot_clock=clock, execution_layer=h.el
+    )
+    clock.set_slot(1)
+    b = h.produce_block(1)
+    h.el.set_mode("invalid")
+    with pytest.raises(BlockError, match="execution payload invalid"):
+        chain.process_block(b)
+    h.el.set_mode("valid")
+
+
+def test_syncing_el_imports_optimistically():
+    spec = _capella_spec()
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(
+        spec, h.state.copy(), slot_clock=clock, execution_layer=h.el
+    )
+    clock.set_slot(1)
+    b = h.produce_block(1)
+    h.el.set_mode("syncing")
+    root = chain.process_block(b)
+    node = chain.fork_choice.proto.get_node(root)
+    assert node.execution_status == ExecutionStatus.OPTIMISTIC
+    h.el.set_mode("valid")
+
+
+def test_tampered_payload_hash_rejected_by_mock():
+    from lighthouse_tpu.execution_layer import MockExecutionLayer, PayloadStatus
+
+    h = StateHarness(_capella_spec(), 16)
+    b = h.produce_block(1)
+    payload = b.message.body.execution_payload
+    payload.block_hash = hashlib.sha256(b"wrong").digest()
+    el = MockExecutionLayer()
+    st = el.notify_new_payload(payload)
+    assert st.status == PayloadStatus.INVALID_BLOCK_HASH
