@@ -53,5 +53,6 @@ class ExecutionEngine:
         """Returns (status, payload_id or None)."""
         raise NotImplementedError
 
-    def get_payload(self, payload_id: bytes):
+    def get_payload(self, payload_id: bytes, payload_cls):
+        """payload_cls is the fork's ExecutionPayload container class."""
         raise NotImplementedError

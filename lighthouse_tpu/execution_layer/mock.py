@@ -44,7 +44,6 @@ class ExecutionBlockGenerator:
     """Tracks the mock execution chain: known-valid block hashes and block
     numbers, and builds child payloads on request."""
 
-    head_hash: bytes = GENESIS_BLOCK_HASH
     blocks: dict = field(
         default_factory=lambda: {GENESIS_BLOCK_HASH: 0}
     )  # hash -> number
@@ -135,6 +134,14 @@ class MockExecutionLayer(ExecutionEngine):
     ) -> tuple[PayloadStatusV1, bytes | None]:
         if self.mode == "syncing":
             return PayloadStatusV1(PayloadStatus.SYNCING), None
+        if self.mode == "invalid":
+            return (
+                PayloadStatusV1(
+                    PayloadStatus.INVALID,
+                    validation_error="mock: forced invalid",
+                ),
+                None,
+            )
         if head_block_hash not in self.generator.blocks:
             return PayloadStatusV1(PayloadStatus.SYNCING), None
         self.head_hash = head_block_hash
@@ -155,7 +162,7 @@ class MockExecutionLayer(ExecutionEngine):
             payload_id,
         )
 
-    def get_payload(self, payload_id: bytes, payload_cls=None):
+    def get_payload(self, payload_id: bytes, payload_cls):
         head_hash, attrs = self._payload_requests.pop(payload_id)
         return self.generator.produce_payload(
             payload_cls,

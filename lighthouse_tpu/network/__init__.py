@@ -1,0 +1,19 @@
+"""Networking layer: transport seam, router, sync, node service.
+
+Twin of the reference's L5 stack (``beacon_node/network`` +
+``lighthouse_network``), built seam-first: the ``Transport`` interface carries
+gossip topics and req/resp RPC; ``LoopbackTransport`` is the in-process
+message bus (the multi-node-without-sockets pattern of
+``testing/simulator/src/local_network.rs:128`` and the sync tests at
+``network/src/sync/tests/lookups.rs``); a libp2p/gossipsub/discv5 transport
+plugs in behind the same interface for real peers. ``Router`` dispatches
+pubsub messages into the beacon processor's prioritized queues
+(``network/src/router.rs:381-535``); ``SyncManager`` does status-driven range
+sync with batched epochs (``network/src/sync/manager.rs``,
+``range_sync/batch.rs``); ``BeaconNodeService`` wires one node together.
+"""
+
+from .router import Router  # noqa: F401
+from .service import BeaconNodeService  # noqa: F401
+from .sync import SyncManager  # noqa: F401
+from .transport import LoopbackTransport, Topic  # noqa: F401

@@ -1,0 +1,85 @@
+"""Router: pubsub + RPC dispatch into the beacon processor.
+
+Twin of ``network/src/router.rs:381-535`` (one arm per PubsubMessage variant)
+plus the ``NetworkBeaconProcessor`` packaging
+(``network_beacon_processor/mod.rs:88-116``): every gossip message becomes a
+``Work`` item with ``process_individual`` AND ``process_batch`` closures so
+the scheduler can form attestation/aggregate batches for the device backend
+(``gossip_methods.rs:198,230``).
+"""
+
+from __future__ import annotations
+
+from ..beacon_processor.processor import Work, WorkType
+from .transport import Status, Topic
+
+
+class Router:
+    def __init__(self, service):
+        self.svc = service
+
+    # -- gossip ------------------------------------------------------------
+
+    def on_gossip(self, topic: str, message, from_peer: str) -> None:
+        svc = self.svc
+        if topic == Topic.BEACON_BLOCK:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipBlock,
+                    item=(message, from_peer),
+                    process_individual=svc.process_gossip_block,
+                )
+            )
+        elif topic == Topic.BEACON_ATTESTATION:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipAttestation,
+                    item=message,
+                    process_individual=svc.process_gossip_attestation,
+                    process_batch=svc.process_gossip_attestation_batch,
+                )
+            )
+        elif topic == Topic.AGGREGATE_AND_PROOF:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipAggregate,
+                    item=message,
+                    process_individual=svc.process_gossip_aggregate,
+                    process_batch=svc.process_gossip_aggregate_batch,
+                )
+            )
+        elif topic == Topic.VOLUNTARY_EXIT:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipVoluntaryExit,
+                    item=message,
+                    process_individual=svc.process_gossip_exit,
+                )
+            )
+        elif topic in (Topic.PROPOSER_SLASHING, Topic.ATTESTER_SLASHING):
+            wt = (
+                WorkType.GossipProposerSlashing
+                if topic == Topic.PROPOSER_SLASHING
+                else WorkType.GossipAttesterSlashing
+            )
+            svc.processor.submit(
+                Work(
+                    work_type=wt,
+                    item=message,
+                    process_individual=svc.process_gossip_slashing,
+                )
+            )
+        # unknown topics are dropped (gossipsub would penalize the peer)
+
+    # -- req/resp ----------------------------------------------------------
+
+    def on_rpc(self, method: str, payload, from_peer: str):
+        if method == "status":
+            self.svc.sync.on_peer_status(from_peer, payload)
+            return self.svc.local_status()
+        if method == "blocks_by_range":
+            start_slot, count = payload
+            return self.svc.blocks_by_range(start_slot, count)
+        if method == "blocks_by_root":
+            return self.svc.blocks_by_root(payload)
+        raise ValueError(f"unknown rpc method {method!r}")

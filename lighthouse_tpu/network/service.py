@@ -1,0 +1,172 @@
+"""BeaconNodeService: one in-process node (chain + processor + router + sync).
+
+The glue the reference spreads across ``NetworkService::spawn``
+(``network/src/service.rs``) and ``NetworkBeaconProcessor``
+(``network_beacon_processor/mod.rs``): gossip handlers feed the chain through
+the prioritized processor queues (batch closures included so attestation
+batches hit the batched BLS path), RPC serves Status/BlocksByRange from the
+chain, and unknown-parent blocks kick the sync manager.
+"""
+
+from __future__ import annotations
+
+from ..beacon_chain.chain import AttestationError, BeaconChain, BlockError
+from ..beacon_processor.processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    Work,
+    WorkType,
+)
+from ..op_pool import OperationPool
+from ..types.helpers import compute_fork_digest
+from .router import Router
+from .sync import SyncManager
+from .transport import Status, Topic, Transport
+
+
+class BeaconNodeService:
+    def __init__(
+        self,
+        node_id: str,
+        spec,
+        genesis_state,
+        transport: Transport,
+        slot_clock=None,
+        execution_layer=None,
+    ):
+        self.node_id = node_id
+        self.transport = transport
+        self.chain = BeaconChain(
+            spec, genesis_state, slot_clock=slot_clock,
+            execution_layer=execution_layer,
+        )
+        self.processor = BeaconProcessor(
+            BeaconProcessorConfig(), synchronous=True
+        )
+        self.op_pool = OperationPool(spec, self.chain.ns.Attestation)
+        self.router = Router(self)
+        self.sync = SyncManager(self)
+        transport.register(node_id, self)
+
+    # -- transport-facing --------------------------------------------------
+
+    def on_gossip(self, topic: str, message, from_peer: str) -> None:
+        self.router.on_gossip(topic, message, from_peer)
+
+    def on_rpc(self, method: str, payload, from_peer: str):
+        return self.router.on_rpc(method, payload, from_peer)
+
+    def local_status(self) -> Status:
+        head = self.chain.head
+        st = head.state
+        return Status(
+            fork_digest=compute_fork_digest(
+                bytes(st.fork.current_version),
+                bytes(st.genesis_validators_root),
+            ),
+            finalized_root=bytes(st.finalized_checkpoint.root),
+            finalized_epoch=int(st.finalized_checkpoint.epoch),
+            head_root=head.root,
+            head_slot=head.slot,
+        )
+
+    def connect(self, peer: str) -> None:
+        """Status handshake with a peer (network service dial path)."""
+        theirs = self.transport.request(
+            self.node_id, peer, "status", self.local_status()
+        )
+        self.sync.on_peer_status(peer, theirs)
+
+    # -- gossip publication ------------------------------------------------
+
+    def publish_block(self, signed_block) -> None:
+        self.transport.publish(self.node_id, Topic.BEACON_BLOCK, signed_block)
+
+    def publish_attestation(self, attestation) -> None:
+        self.transport.publish(
+            self.node_id, Topic.BEACON_ATTESTATION, attestation
+        )
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        self.transport.publish(
+            self.node_id, Topic.AGGREGATE_AND_PROOF, signed_aggregate
+        )
+
+    # -- work handlers (network_beacon_processor/gossip_methods.rs) --------
+
+    def process_gossip_block(self, item) -> None:
+        block, from_peer = item
+        try:
+            self.chain.process_block(block)
+        except BlockError as e:
+            if "unknown parent" in str(e):
+                # ask the sender where we are (single-block lookup -> range)
+                try:
+                    theirs = self.transport.request(
+                        self.node_id, from_peer, "status", self.local_status()
+                    )
+                    self.sync.on_peer_status(from_peer, theirs)
+                    self.chain.process_block(block)
+                except (ConnectionError, BlockError):
+                    pass
+            # other invalid blocks are dropped (peer scoring would fire here)
+
+    def process_gossip_attestation(self, att) -> None:
+        self.process_gossip_attestation_batch([att])
+
+    def process_gossip_attestation_batch(self, atts) -> None:
+        results = self.chain.verify_unaggregated_attestations(atts)
+        for att, verdict in results:
+            if not isinstance(verdict, Exception):
+                self.op_pool.insert_attestation(att)
+
+    def process_gossip_aggregate(self, agg) -> None:
+        self.process_gossip_aggregate_batch([agg])
+
+    def process_gossip_aggregate_batch(self, aggs) -> None:
+        results = self.chain.verify_aggregated_attestations(aggs)
+        for sap, verdict in results:
+            if not isinstance(verdict, Exception):
+                self.op_pool.insert_attestation(sap.message.aggregate)
+
+    def process_gossip_exit(self, exit_msg) -> None:
+        self.op_pool.insert_voluntary_exit(exit_msg)
+
+    def process_gossip_slashing(self, slashing) -> None:
+        try:
+            self.op_pool.insert_attester_slashing(slashing)
+        except Exception:
+            self.op_pool.insert_proposer_slashing(slashing)
+
+    def process_chain_segment(self, blocks) -> None:
+        try:
+            self.chain.process_chain_segment(list(blocks))
+        except BlockError:
+            pass  # scored + retried against another peer in the full stack
+
+    # -- rpc handlers ------------------------------------------------------
+
+    def blocks_by_range(self, start_slot: int, count: int) -> list:
+        """Canonical-chain blocks in [start_slot, start_slot+count)
+        (rpc_methods.rs BlocksByRange)."""
+        out = []
+        root = self.chain.head.root
+        chain_blocks = []
+        while root is not None:
+            sb = self.chain._blocks.get(root)
+            if sb is None:
+                break
+            chain_blocks.append(sb)
+            root = bytes(sb.message.parent_root)
+            if root not in self.chain._blocks and root != self.chain.genesis_block_root:
+                break
+        for sb in reversed(chain_blocks):
+            s = int(sb.message.slot)
+            if start_slot <= s < start_slot + count:
+                out.append(sb)
+        return out
+
+    def blocks_by_root(self, roots) -> list:
+        return [
+            self.chain._blocks[r] for r in roots if r in self.chain._blocks
+        ]

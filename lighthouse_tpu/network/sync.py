@@ -1,0 +1,85 @@
+"""SyncManager: status-driven range sync with batched epochs.
+
+Twin of ``network/src/sync/manager.rs`` (peer status intake, choosing a sync
+target) + ``range_sync/{chain,batch}.rs`` (per-epoch batches requested via
+BlocksByRange and imported as chain segments through the processor's
+ChainSegment queue). Unknown-parent blocks trigger a sync round against the
+best peer (the single-block-lookup path collapses into range sync here).
+"""
+
+from __future__ import annotations
+
+from ..beacon_processor.processor import Work, WorkType
+from .transport import Status
+
+EPOCHS_PER_BATCH = 2  # range_sync/batch.rs EPOCHS_PER_BATCH
+
+
+class SyncManager:
+    def __init__(self, service):
+        self.svc = service
+        self.peer_status: dict[str, Status] = {}
+        self.syncing = False
+
+    # -- peer intake -------------------------------------------------------
+
+    def on_peer_status(self, peer: str, status: Status) -> None:
+        self.peer_status[peer] = status
+        self.maybe_sync()
+
+    def best_peer(self):
+        """Peer with the highest head slot beyond our own."""
+        ours = self.svc.chain.head.slot
+        best = None
+        for peer, st in self.peer_status.items():
+            if st.head_slot > ours and (
+                best is None or st.head_slot > self.peer_status[best].head_slot
+            ):
+                best = peer
+        return best
+
+    # -- range sync --------------------------------------------------------
+
+    def maybe_sync(self) -> None:
+        if self.syncing:
+            return
+        peer = self.best_peer()
+        if peer is None:
+            return
+        self.syncing = True
+        try:
+            self._range_sync(peer)
+        finally:
+            self.syncing = False
+
+    def _range_sync(self, peer: str) -> None:
+        """Batched-epoch requests from our FINALIZED epoch to the peer's head.
+
+        Starting at finalized (not at our head) is what makes the sync fork-
+        tolerant: if we diverged from the peer after finality, the segment
+        walks their branch from a block whose parent we share
+        (range_sync/chain.rs starts chains at the local finalized epoch)."""
+        chain = self.svc.chain
+        spec = chain.spec
+        batch_slots = EPOCHS_PER_BATCH * spec.preset.SLOTS_PER_EPOCH
+        target = self.peer_status[peer].head_slot
+        start = spec.start_slot(
+            int(chain.head.state.finalized_checkpoint.epoch)
+        ) + 1
+        while start <= target:
+            try:
+                blocks = self.svc.transport.request(
+                    self.svc.node_id, peer, "blocks_by_range",
+                    (start, batch_slots),
+                )
+            except ConnectionError:
+                return
+            if blocks:
+                self.svc.processor.submit(
+                    Work(
+                        work_type=WorkType.ChainSegment,
+                        item=blocks,
+                        process_individual=self.svc.process_chain_segment,
+                    )
+                )
+            start += batch_slots

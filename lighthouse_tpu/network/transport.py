@@ -1,0 +1,89 @@
+"""Transport seam + the in-process loopback implementation.
+
+Gossip topics mirror the gossipsub topic family
+(``lighthouse_network/src/types/topics.rs``); req/resp mirrors the Req/Resp
+protocols (``lighthouse_network/src/rpc/protocol.rs``: Status, BlocksByRange,
+BlocksByRoot). The loopback bus delivers synchronously and deterministically —
+the shape ``testing/simulator`` relies on for multi-node tests without
+sockets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class Topic:
+    BEACON_BLOCK = "beacon_block"
+    BEACON_ATTESTATION = "beacon_attestation"  # subnet topics collapse to one
+    AGGREGATE_AND_PROOF = "beacon_aggregate_and_proof"
+    VOLUNTARY_EXIT = "voluntary_exit"
+    PROPOSER_SLASHING = "proposer_slashing"
+    ATTESTER_SLASHING = "attester_slashing"
+
+
+@dataclass
+class Status:
+    """Req/resp Status handshake payload (rpc STATUS message)."""
+
+    fork_digest: bytes
+    finalized_root: bytes
+    finalized_epoch: int
+    head_root: bytes
+    head_slot: int
+
+
+class Transport:
+    """What a node needs from the wire: publish/subscribe + peer RPC."""
+
+    def publish(self, from_peer: str, topic: str, message) -> None:
+        raise NotImplementedError
+
+    def request(self, from_peer: str, to_peer: str, method: str, payload):
+        raise NotImplementedError
+
+    def peers(self, exclude: str | None = None) -> list[str]:
+        raise NotImplementedError
+
+
+class LoopbackTransport(Transport):
+    """All nodes in one process; delivery is an immediate method call.
+
+    Fault injection: ``partition(a, b)`` drops traffic between two peers
+    (both gossip and RPC) until ``heal()``.
+    """
+
+    def __init__(self):
+        self._handlers: dict[str, object] = {}  # peer_id -> service
+        self._partitions: set[frozenset] = set()
+
+    def register(self, peer_id: str, service) -> None:
+        if peer_id in self._handlers:
+            raise ValueError(f"duplicate peer id {peer_id}")
+        self._handlers[peer_id] = service
+
+    def partition(self, a: str, b: str) -> None:
+        self._partitions.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self._partitions.clear()
+
+    def _blocked(self, a: str, b: str) -> bool:
+        return frozenset((a, b)) in self._partitions
+
+    def publish(self, from_peer: str, topic: str, message) -> None:
+        for pid, svc in list(self._handlers.items()):
+            if pid == from_peer or self._blocked(pid, from_peer):
+                continue
+            svc.on_gossip(topic, message, from_peer)
+
+    def request(self, from_peer: str, to_peer: str, method: str, payload):
+        if self._blocked(from_peer, to_peer):
+            raise ConnectionError(f"partitioned: {from_peer} <-> {to_peer}")
+        svc = self._handlers.get(to_peer)
+        if svc is None:
+            raise ConnectionError(f"unknown peer {to_peer}")
+        return svc.on_rpc(method, payload, from_peer)
+
+    def peers(self, exclude: str | None = None) -> list[str]:
+        return [p for p in self._handlers if p != exclude]

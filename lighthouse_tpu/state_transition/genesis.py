@@ -97,7 +97,9 @@ def interop_genesis_state(
         sc = get_next_sync_committee(spec, state)
         state.current_sync_committee = sc
         state.next_sync_committee = get_next_sync_committee(spec, state)
-    if fork_name in ("bellatrix", "capella", "deneb", "electra"):
+    from ..types.spec import fork_at_least
+
+    if fork_at_least(fork_name, "bellatrix"):
         # post-merge interop genesis: the execution chain starts at the mock
         # EL's genesis block so payload parent hashes link up
         # (interop.rs + mock_execution_layer genesis wiring)

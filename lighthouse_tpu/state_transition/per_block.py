@@ -10,6 +10,7 @@ path. Operations parity: ``per_block_processing/process_operations.rs``.
 from __future__ import annotations
 
 import enum
+import functools
 
 import numpy as np
 
@@ -20,7 +21,7 @@ from ..types.helpers import (
     compute_signing_root, get_domain, is_active_validator,
     is_slashable_attestation_data, is_slashable_validator,
 )
-from ..types.spec import ChainSpec, FAR_FUTURE_EPOCH
+from ..types.spec import ChainSpec, FAR_FUTURE_EPOCH, fork_at_least
 from . import signature_sets as sigs
 from .beacon_state_util import (
     StateTransitionError,
@@ -270,7 +271,7 @@ def per_block_processing(
     fork = getattr(state, "fork_name", "phase0")
     payload = getattr(block.body, "execution_payload", None)
     if payload is not None and is_execution_enabled(state, payload):
-        if fork in ("capella", "deneb", "electra"):
+        if fork_at_least(fork, "capella"):
             process_withdrawals(spec, state, payload)
         # EL notify_new_payload happens at the chain layer
         # (block_verification.rs ExecutionPendingBlock); here only the
@@ -378,15 +379,25 @@ def process_operations(spec, state, body, ctxt: ConsensusContext, verify: bool):
 # -- execution payloads (bellatrix+) ---------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
+def _default_tree_root(cls) -> bytes:
+    return cls().tree_root()
+
+
+@functools.lru_cache(maxsize=None)
+def _default_encoding(cls) -> bytes:
+    return cls.encode(cls())
+
+
 def is_merge_transition_complete(state) -> bool:
     hdr = getattr(state, "latest_execution_payload_header", None)
     if hdr is None:
         return False
-    return hdr.tree_root() != type(hdr)().tree_root()
+    return hdr.tree_root() != _default_tree_root(type(hdr))
 
 
 def payload_is_default(payload) -> bool:
-    return type(payload).encode(payload) == type(payload).encode(type(payload)())
+    return type(payload).encode(payload) == _default_encoding(type(payload))
 
 
 def is_execution_enabled(state, payload) -> bool:

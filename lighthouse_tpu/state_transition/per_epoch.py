@@ -382,7 +382,9 @@ def process_historical_roots_update(spec, state):
         from ..types.containers import for_preset
 
         ns = for_preset(spec.preset.name)
-        if getattr(state, "fork_name", "phase0") in ("capella", "deneb", "electra"):
+        from ..types.spec import fork_at_least
+
+        if fork_at_least(getattr(state, "fork_name", "phase0"), "capella"):
             # capella: accumulate summaries instead of batch roots
             from ..types.containers import HistoricalSummary
             from ..ssz import Vector

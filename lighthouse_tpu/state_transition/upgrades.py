@@ -9,6 +9,7 @@ matching the mutate-in-place convention of the rest of the transition code.
 from __future__ import annotations
 
 from ..types.containers import Fork, for_preset
+from .per_block import BlockProcessingError
 from ..types.spec import ChainSpec
 from .beacon_state_util import get_current_epoch, invalidate_caches
 
@@ -46,7 +47,7 @@ def upgrade_to_altair(spec: ChainSpec, state) -> None:
             flag_indices = get_attestation_participation_flag_indices(
                 spec, state, att.data, int(att.inclusion_delay)
             )
-        except Exception:
+        except BlockProcessingError:
             continue  # source no longer matches after the boundary: no flags
         committee = get_beacon_committee(
             spec, state, int(att.data.slot), int(att.data.index)

@@ -15,7 +15,7 @@ import numpy as np
 from ..ops.bls_oracle.fields import R as CURVE_ORDER
 from ..types.containers import Checkpoint, for_preset
 from ..types.helpers import compute_signing_root, get_domain
-from ..types.spec import ChainSpec
+from ..types.spec import ChainSpec, fork_at_least
 from ..ssz import uint64
 from ..state_transition import (
     get_beacon_committee,
@@ -207,7 +207,7 @@ class StateHarness:
         )
         if fork != "phase0":
             body.sync_aggregate = self._sync_aggregate(state, slot)
-        if fork in ("bellatrix", "capella", "deneb", "electra"):
+        if fork_at_least(fork, "bellatrix"):
             body.execution_payload = self._execution_payload(state, slot, fork)
         inner_cls = dict(block_cls.FIELDS)["message"]
         block = inner_cls(
@@ -268,7 +268,7 @@ class StateHarness:
 
         payload_cls = self.ns.payload_types[fork]
         withdrawals = None
-        if fork in ("capella", "deneb", "electra"):
+        if fork_at_least(fork, "capella"):
             withdrawals = get_expected_withdrawals(self.spec, state)
         # pre-merge bellatrix state: this block IS the merge transition —
         # build the first payload on the mock EL's genesis block

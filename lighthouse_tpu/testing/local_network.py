@@ -1,0 +1,162 @@
+"""LocalNetwork: N in-process beacon nodes over the loopback transport.
+
+Twin of ``testing/simulator/src/local_network.rs:128`` + ``checks.rs``:
+validators are partitioned across nodes, every slot the owning node proposes
+and publishes the block over gossip, every node's validators attest over
+gossip (feeding each node's op pool through the batched verification path),
+and the checks assert finalization advances on ALL nodes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..network import BeaconNodeService, LoopbackTransport
+from ..state_transition import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+    get_current_epoch,
+    process_slots,
+)
+from ..types.containers import AttestationData, Checkpoint, SigningData
+from ..types.helpers import compute_signing_root, get_domain
+from ..types.spec import ChainSpec
+from ..ssz import uint64
+from ..utils.slot_clock import ManualSlotClock
+from .harness import StateHarness
+
+
+class LocalNetwork:
+    def __init__(self, spec: ChainSpec, n_nodes: int, n_validators: int):
+        assert n_validators % n_nodes == 0
+        self.spec = spec
+        self.transport = LoopbackTransport()
+        self.clock = ManualSlotClock(0)
+        # one harness supplies genesis + deterministic keys; each node only
+        # "owns" (signs with) its shard of the validator set
+        self.harness = StateHarness(spec, n_validators)
+        self.nodes: list[BeaconNodeService] = []
+        per = n_validators // n_nodes
+        self.owned: list[range] = []
+        for i in range(n_nodes):
+            svc = BeaconNodeService(
+                f"node_{i}",
+                spec,
+                self.harness.state.copy(),
+                self.transport,
+                slot_clock=self.clock,
+                execution_layer=self.harness.el,
+            )
+            self.nodes.append(svc)
+            self.owned.append(range(i * per, (i + 1) * per))
+        for i, svc in enumerate(self.nodes):
+            for peer in self.transport.peers(exclude=svc.node_id):
+                svc.connect(peer)
+
+    def _owner_of(self, validator_index: int) -> BeaconNodeService:
+        for node, rng in zip(self.nodes, self.owned):
+            if validator_index in rng:
+                return node
+        raise ValueError(validator_index)
+
+    # -- per-slot duties ---------------------------------------------------
+
+    def _propose(self, slot: int) -> None:
+        spec = self.spec
+        # duty lookup on any node's head (all agree or sync will catch up)
+        ref = self.nodes[0].chain
+        state = ref.head.state.copy()
+        if state.slot < slot:
+            process_slots(spec, state, slot)
+        proposer = get_beacon_proposer_index(spec, state)
+        node = self._owner_of(proposer)
+
+        chain = node.chain
+        epoch = get_current_epoch(spec, state)
+        domain_r = get_domain(spec, state, spec.DOMAIN_RANDAO, epoch=epoch)
+        randao_root = SigningData(
+            object_root=uint64.hash_tree_root(epoch), domain=domain_r
+        ).tree_root()
+        reveal = self.harness._sign(proposer, randao_root)
+        atts = node.op_pool.get_attestations(state)
+        block, _post = chain.produce_block_on_state(
+            chain.head.state, slot, reveal, attestations=atts
+        )
+        fork = spec.fork_name_at_epoch(epoch)
+        block_cls = node.chain.ns.block_types[fork]
+        domain_b = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=epoch)
+        sig = self.harness._sign(proposer, compute_signing_root(block, domain_b))
+        signed = block_cls(message=block, signature=sig)
+        node.chain.process_block(signed)
+        node.publish_block(signed)
+
+    def _attest(self, slot: int) -> None:
+        spec = self.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        for node, owned in zip(self.nodes, self.owned):
+            state = node.chain.head.state
+            if state.slot < slot:
+                state = state.copy()
+                process_slots(spec, state, slot)
+            head_root = node.chain.head.root
+            target_root = (
+                head_root
+                if slot == spec.start_slot(epoch)
+                else _block_root_at(spec, state, spec.start_slot(epoch))
+            )
+            domain = get_domain(
+                spec, state, spec.DOMAIN_BEACON_ATTESTER, epoch=epoch
+            )
+            for index in range(get_committee_count_per_slot(spec, state, epoch)):
+                committee = get_beacon_committee(spec, state, slot, index)
+                data = AttestationData(
+                    slot=slot,
+                    index=index,
+                    beacon_block_root=head_root,
+                    source=state.current_justified_checkpoint,
+                    target=Checkpoint(epoch=epoch, root=target_root),
+                )
+                root = compute_signing_root(data, domain)
+                for pos, v in enumerate(committee):
+                    if int(v) not in owned:
+                        continue
+                    bits = np.zeros(committee.size, dtype=bool)
+                    bits[pos] = True
+                    att = node.chain.ns.Attestation(
+                        aggregation_bits=bits,
+                        data=data,
+                        signature=self.harness._sign(int(v), root),
+                    )
+                    node.op_pool.insert_attestation(att)
+                    node.publish_attestation(att)
+
+    def run_slot(self, slot: int) -> None:
+        self.clock.set_slot(slot)
+        self._propose(slot)
+        self._attest(slot)
+
+    def run_until(self, last_slot: int, start: int = 1) -> None:
+        for slot in range(start, last_slot + 1):
+            self.run_slot(slot)
+
+    # -- checks (simulator/src/checks.rs) ----------------------------------
+
+    def head_slots(self) -> list[int]:
+        return [n.chain.head.slot for n in self.nodes]
+
+    def finalized_epochs(self) -> list[int]:
+        return [
+            int(n.chain.head.state.finalized_checkpoint.epoch)
+            for n in self.nodes
+        ]
+
+    def heads_agree(self) -> bool:
+        roots = {n.chain.head.root for n in self.nodes}
+        return len(roots) == 1
+
+
+def _block_root_at(spec, state, slot: int) -> bytes:
+    from ..state_transition import get_block_root_at_slot
+
+    return get_block_root_at_slot(spec, state, slot)

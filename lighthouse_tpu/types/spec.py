@@ -17,6 +17,13 @@ FAR_FUTURE_EPOCH = 2**64 - 1
 
 # Fork names in activation order (superstruct variant order in the reference).
 FORK_ORDER = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+_FORK_RANK = {f: i for i, f in enumerate(FORK_ORDER)}
+
+
+def fork_at_least(fork_name: str, target: str) -> bool:
+    """True when fork_name is target or any later fork (single source of
+    fork-ordering truth for feature gating)."""
+    return _FORK_RANK[fork_name] >= _FORK_RANK[target]
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,52 @@
+"""Multi-node simulator: gossip, sync, and finalization across 4 nodes.
+
+VERDICT round-1 item 6. Done-criteria: a simulator run where finalization
+advances on ALL nodes (checks.rs parity), plus range-sync catch-up for a
+partitioned node.
+"""
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.testing.local_network import LocalNetwork
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def net():
+    # phase0 keeps the sim focused on blocks+attestations+finality
+    return LocalNetwork(minimal_spec(), n_nodes=4, n_validators=32)
+
+
+def test_four_nodes_finalize(net):
+    spe = net.spec.preset.SLOTS_PER_EPOCH
+    net.run_until(4 * spe)
+    assert net.heads_agree(), f"heads diverged: {net.head_slots()}"
+    fins = net.finalized_epochs()
+    assert all(f >= 2 for f in fins), f"finalization stalled: {fins}"
+
+
+def test_partitioned_node_catches_up_via_range_sync(net):
+    spe = net.spec.preset.SLOTS_PER_EPOCH
+    start = net.nodes[0].chain.head.slot + 1
+    # cut node_3 off from everyone
+    for other in ("node_0", "node_1", "node_2"):
+        net.transport.partition("node_3", other)
+    end = start + spe - 1
+    net.run_until(end, start=start)
+    behind = net.nodes[3].chain.head.slot
+    ahead = net.nodes[0].chain.head.slot
+    assert behind < ahead, "partitioned node should have fallen behind"
+    # heal + reconnect: status handshake triggers range sync
+    net.transport.heal()
+    net.nodes[3].connect("node_0")
+    assert net.nodes[3].chain.head.slot == ahead
+    assert net.nodes[3].chain.head.root == net.nodes[0].chain.head.root
