@@ -1,0 +1,7 @@
+"""Typed Beacon-API HTTP client (the ``common/eth2`` twin).
+
+Used by the validator client's services and by tests/tools; every method maps
+one endpoint of ``http_api`` (``common/eth2/src/lib.rs`` BeaconNodeHttpClient).
+"""
+
+from .client import ApiClientError, BeaconNodeHttpClient  # noqa: F401

@@ -1,0 +1,383 @@
+"""Beacon-API server implementation.
+
+Work items arriving over HTTP correspond to the reference's ApiRequestP0/P1
+beacon-processor queues (``beacon_processor/src/lib.rs:629-630``); here the
+handler calls the chain directly (the stdlib threading server provides the
+concurrency seam). Endpoints follow the Eth Beacon API paths served by
+``http_api/src/lib.rs`` with SSZ-hex payload envelopes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..state_transition import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+    process_slots,
+)
+from ..types.containers import AttestationData, Checkpoint
+from ..types.helpers import compute_fork_digest
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _unhex(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class BeaconApiServer:
+    """Wraps a BeaconChain (and optionally its op pool / gossip publisher —
+    a BeaconNodeService provides both) behind the Beacon API."""
+
+    def __init__(self, chain, op_pool=None, network_service=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.chain = chain
+        self.op_pool = op_pool
+        self.network = network_service
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "BeaconApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- state resolution --------------------------------------------------
+
+    def _state(self, state_id: str):
+        if state_id in ("head", "justified", "finalized"):
+            st = self.chain.head.state
+            return st
+        raise ApiError(400, f"unsupported state id {state_id!r}")
+
+    # -- endpoint handlers -------------------------------------------------
+
+    def get_genesis(self):
+        st = self.chain.genesis_state
+        return {
+            "genesis_time": str(int(st.genesis_time)),
+            "genesis_validators_root": _hex(st.genesis_validators_root),
+            "genesis_fork_version": _hex(self.chain.spec.genesis_fork_version),
+        }
+
+    def get_fork(self, state_id: str):
+        st = self._state(state_id)
+        return {
+            "previous_version": _hex(st.fork.previous_version),
+            "current_version": _hex(st.fork.current_version),
+            "epoch": str(int(st.fork.epoch)),
+        }
+
+    def get_finality_checkpoints(self, state_id: str):
+        st = self._state(state_id)
+
+        def cp(c):
+            return {"epoch": str(int(c.epoch)), "root": _hex(c.root)}
+
+        return {
+            "previous_justified": cp(st.previous_justified_checkpoint),
+            "current_justified": cp(st.current_justified_checkpoint),
+            "finalized": cp(st.finalized_checkpoint),
+        }
+
+    def get_validators(self, state_id: str):
+        st = self._state(state_id)
+        out = []
+        for i, v in enumerate(st.validators):
+            out.append(
+                {
+                    "index": str(i),
+                    "balance": str(int(st.balances[i])),
+                    "status": "active_ongoing",
+                    "validator": {"pubkey": _hex(v.pubkey)},
+                }
+            )
+        return out
+
+    def get_syncing(self):
+        head = self.chain.head.slot
+        current = self.chain.current_slot()
+        return {
+            "head_slot": str(head),
+            "sync_distance": str(max(0, current - head)),
+            "is_syncing": current > head + 1,
+            "is_optimistic": False,
+            "el_offline": self.chain.execution_layer is None,
+        }
+
+    def get_proposer_duties(self, epoch: int):
+        spec = self.chain.spec
+        state = self.chain.head.state.copy()
+        start = spec.start_slot(epoch)
+        if state.slot < start:
+            process_slots(spec, state, start)
+        duties = []
+        for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
+            if state.slot < slot:
+                process_slots(spec, state, slot)
+            idx = get_beacon_proposer_index(spec, state)
+            duties.append(
+                {
+                    "pubkey": _hex(state.validators[idx].pubkey),
+                    "validator_index": str(idx),
+                    "slot": str(slot),
+                }
+            )
+        return duties
+
+    def get_attester_duties(self, epoch: int, indices: list[int]):
+        spec = self.chain.spec
+        state = self.chain.head.state.copy()
+        start = spec.start_slot(epoch)
+        if state.slot < start:
+            process_slots(spec, state, start)
+        wanted = set(indices)
+        duties = []
+        for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
+            for index in range(get_committee_count_per_slot(spec, state, epoch)):
+                committee = get_beacon_committee(spec, state, slot, index)
+                for pos, v in enumerate(committee):
+                    if int(v) in wanted:
+                        duties.append(
+                            {
+                                "pubkey": _hex(state.validators[int(v)].pubkey),
+                                "validator_index": str(int(v)),
+                                "committee_index": str(index),
+                                "committee_length": str(committee.size),
+                                "committees_at_slot": str(
+                                    get_committee_count_per_slot(spec, state, epoch)
+                                ),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+        return duties
+
+    def get_attestation_data(self, slot: int, committee_index: int):
+        spec = self.chain.spec
+        chain = self.chain
+        state = chain.head.state
+        if state.slot < slot:
+            state = state.copy()
+            process_slots(spec, state, slot)
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        head_root = chain.head.root
+        if slot == spec.start_slot(epoch) and chain.head.slot <= slot:
+            target_root = head_root
+        else:
+            from ..state_transition import get_block_root_at_slot
+
+            target_root = get_block_root_at_slot(
+                spec, state, spec.start_slot(epoch)
+            )
+        data = AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+        return {"data": _hex(AttestationData.encode(data))}
+
+    def produce_block(self, slot: int, randao_reveal: bytes, graffiti: bytes):
+        chain = self.chain
+        atts = self.op_pool.get_attestations(
+            _advanced(chain, slot)
+        ) if self.op_pool else []
+        block, _post = chain.produce_block_on_state(
+            chain.head.state, slot, randao_reveal, attestations=atts,
+            graffiti=graffiti or b"\x00" * 32,
+        )
+        fork = chain.spec.fork_name_at_epoch(
+            slot // chain.spec.preset.SLOTS_PER_EPOCH
+        )
+        inner_cls = dict(chain.ns.block_types[fork].FIELDS)["message"]
+        return {
+            "version": fork,
+            "data": _hex(inner_cls.encode(block)),
+        }
+
+    def publish_block(self, body: dict):
+        version = body.get("version", None)
+        fork = version or self.chain.spec.fork_name_at_slot(
+            self.chain.current_slot()
+        )
+        block_cls = self.chain.ns.block_types[fork]
+        signed = block_cls.decode(_unhex(body["data"]))
+        from ..beacon_chain.chain import BlockError
+
+        try:
+            self.chain.process_block(signed)
+        except BlockError as e:
+            raise ApiError(400, str(e)) from None
+        if self.network is not None:
+            self.network.publish_block(signed)
+        return {}
+
+    def publish_attestations(self, body: list):
+        att_cls = self.chain.ns.Attestation
+        atts = [att_cls.decode(_unhex(item["data"])) for item in body]
+        results = self.chain.verify_unaggregated_attestations(atts)
+        failures = []
+        for i, (att, verdict) in enumerate(results):
+            if isinstance(verdict, Exception):
+                failures.append({"index": i, "message": str(verdict)})
+                continue
+            if self.op_pool is not None:
+                self.op_pool.insert_attestation(att)
+            if self.network is not None:
+                self.network.publish_attestation(att)
+        if failures:
+            raise ApiError(400, json.dumps(failures))
+        return {}
+
+    def get_header(self):
+        head = self.chain.head
+        return {
+            "root": _hex(head.root),
+            "header": {"slot": str(head.slot)},
+        }
+
+
+def _advanced(chain, slot):
+    state = chain.head.state
+    if state.slot < slot:
+        state = state.copy()
+        process_slots(chain.spec, state, slot)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# HTTP plumbing
+# ---------------------------------------------------------------------------
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("GET", re.compile(r"^/eth/v1/beacon/genesis$"), "genesis"),
+    ("GET", re.compile(r"^/eth/v1/beacon/states/(\w+)/fork$"), "fork"),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/beacon/states/(\w+)/finality_checkpoints$"),
+        "finality",
+    ),
+    ("GET", re.compile(r"^/eth/v1/beacon/states/(\w+)/validators$"), "validators"),
+    ("GET", re.compile(r"^/eth/v1/node/syncing$"), "syncing"),
+    ("GET", re.compile(r"^/eth/v1/node/version$"), "version"),
+    ("GET", re.compile(r"^/eth/v1/validator/duties/proposer/(\d+)$"), "proposer"),
+    ("POST", re.compile(r"^/eth/v1/validator/duties/attester/(\d+)$"), "attester"),
+    ("GET", re.compile(r"^/eth/v1/validator/attestation_data$"), "att_data"),
+    ("GET", re.compile(r"^/eth/v2/validator/blocks/(\d+)$"), "produce_block"),
+    ("POST", re.compile(r"^/eth/v1/beacon/blocks$"), "publish_block"),
+    ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_atts"),
+    ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
+]
+
+
+def _make_handler(api: BeaconApiServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _reply(self, code: int, payload) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n) if n else b"{}"
+            return json.loads(raw.decode() or "{}")
+
+        def _dispatch(self, method: str) -> None:
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            try:
+                for m, pat, name in _ROUTES:
+                    if m != method:
+                        continue
+                    match = pat.match(u.path)
+                    if not match:
+                        continue
+                    q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                    out = self._route(name, match, q)
+                    self._reply(200, {"data": out} if name != "produce_block" else out)
+                    return
+                self._reply(404, {"message": f"no route {u.path}"})
+            except ApiError as e:
+                self._reply(e.code, {"message": str(e)})
+            except Exception as e:  # noqa: BLE001 — API boundary
+                self._reply(500, {"message": f"{type(e).__name__}: {e}"})
+
+        def _route(self, name: str, match, q):
+            if name == "genesis":
+                return api.get_genesis()
+            if name == "fork":
+                return api.get_fork(match.group(1))
+            if name == "finality":
+                return api.get_finality_checkpoints(match.group(1))
+            if name == "validators":
+                return api.get_validators(match.group(1))
+            if name == "syncing":
+                return api.get_syncing()
+            if name == "version":
+                return {"version": "lighthouse_tpu/0.1.0"}
+            if name == "proposer":
+                return api.get_proposer_duties(int(match.group(1)))
+            if name == "attester":
+                return api.get_attester_duties(
+                    int(match.group(1)), [int(x) for x in self._body()]
+                )
+            if name == "att_data":
+                return api.get_attestation_data(
+                    int(q["slot"]), int(q.get("committee_index", 0))
+                )
+            if name == "produce_block":
+                return api.produce_block(
+                    int(match.group(1)),
+                    _unhex(q["randao_reveal"]),
+                    _unhex(q["graffiti"]) if "graffiti" in q else b"",
+                )
+            if name == "publish_block":
+                return api.publish_block(self._body())
+            if name == "publish_atts":
+                return api.publish_attestations(self._body())
+            if name == "header":
+                return api.get_header()
+            raise ApiError(500, f"unwired route {name}")
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+    return Handler

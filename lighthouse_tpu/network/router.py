@@ -11,7 +11,7 @@ the scheduler can form attestation/aggregate batches for the device backend
 from __future__ import annotations
 
 from ..beacon_processor.processor import Work, WorkType
-from .transport import Status, Topic
+from .transport import Topic
 
 
 class Router:
@@ -56,17 +56,20 @@ class Router:
                     process_individual=svc.process_gossip_exit,
                 )
             )
-        elif topic in (Topic.PROPOSER_SLASHING, Topic.ATTESTER_SLASHING):
-            wt = (
-                WorkType.GossipProposerSlashing
-                if topic == Topic.PROPOSER_SLASHING
-                else WorkType.GossipAttesterSlashing
-            )
+        elif topic == Topic.PROPOSER_SLASHING:
             svc.processor.submit(
                 Work(
-                    work_type=wt,
+                    work_type=WorkType.GossipProposerSlashing,
                     item=message,
-                    process_individual=svc.process_gossip_slashing,
+                    process_individual=svc.process_gossip_proposer_slashing,
+                )
+            )
+        elif topic == Topic.ATTESTER_SLASHING:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipAttesterSlashing,
+                    item=message,
+                    process_individual=svc.process_gossip_attester_slashing,
                 )
             )
         # unknown topics are dropped (gossipsub would penalize the peer)

@@ -10,13 +10,8 @@ chain, and unknown-parent blocks kick the sync manager.
 
 from __future__ import annotations
 
-from ..beacon_chain.chain import AttestationError, BeaconChain, BlockError
-from ..beacon_processor.processor import (
-    BeaconProcessor,
-    BeaconProcessorConfig,
-    Work,
-    WorkType,
-)
+from ..beacon_chain.chain import BeaconChain, BlockError
+from ..beacon_processor.processor import BeaconProcessor, BeaconProcessorConfig
 from ..op_pool import OperationPool
 from ..types.helpers import compute_fork_digest
 from .router import Router
@@ -132,11 +127,11 @@ class BeaconNodeService:
     def process_gossip_exit(self, exit_msg) -> None:
         self.op_pool.insert_voluntary_exit(exit_msg)
 
-    def process_gossip_slashing(self, slashing) -> None:
-        try:
-            self.op_pool.insert_attester_slashing(slashing)
-        except Exception:
-            self.op_pool.insert_proposer_slashing(slashing)
+    def process_gossip_proposer_slashing(self, slashing) -> None:
+        self.op_pool.insert_proposer_slashing(slashing)
+
+    def process_gossip_attester_slashing(self, slashing) -> None:
+        self.op_pool.insert_attester_slashing(slashing)
 
     def process_chain_segment(self, blocks) -> None:
         try:
@@ -151,19 +146,17 @@ class BeaconNodeService:
         (rpc_methods.rs BlocksByRange)."""
         out = []
         root = self.chain.head.root
-        chain_blocks = []
         while root is not None:
             sb = self.chain._blocks.get(root)
             if sb is None:
                 break
-            chain_blocks.append(sb)
-            root = bytes(sb.message.parent_root)
-            if root not in self.chain._blocks and root != self.chain.genesis_block_root:
-                break
-        for sb in reversed(chain_blocks):
             s = int(sb.message.slot)
-            if start_slot <= s < start_slot + count:
+            if s < start_slot:
+                break  # walking backwards: everything older is out of range
+            if s < start_slot + count:
                 out.append(sb)
+            root = bytes(sb.message.parent_root)
+        out.reverse()
         return out
 
     def blocks_by_root(self, roots) -> list:

@@ -1,0 +1,152 @@
+"""Validator-client services: duties, attestation, block production.
+
+Twin of ``validator_client/validator_services/src/{duties_service,
+attestation_service,block_service}.rs``: duties polled from the BN over HTTP,
+per-slot attestation signing + publication, proposer-duty block production —
+all signing through the ValidatorStore (slashing-protected) and all BN
+interaction through the typed HTTP client only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..api_client import BeaconNodeHttpClient
+from ..api_client.client import AttesterDuty, ProposerDuty
+from ..types.containers import AttestationData, Fork, for_preset
+from .validator_store import ValidatorStore
+
+
+@dataclass
+class ForkInfo:
+    """The slice of state that domain computation needs (fork +
+    genesis_validators_root), built from API responses — the VC never holds a
+    BeaconState."""
+
+    fork: Fork
+    genesis_validators_root: bytes
+
+
+class DutiesService:
+    """Polls proposer/attester duties per epoch (duties_service.rs)."""
+
+    def __init__(self, client: BeaconNodeHttpClient, store: ValidatorStore):
+        self.client = client
+        self.store = store
+        self._indices: dict[bytes, int] = {}
+        self.proposer: dict[int, list[ProposerDuty]] = {}
+        self.attester: dict[int, list[AttesterDuty]] = {}
+
+    def validator_indices(self) -> dict[bytes, int]:
+        if not self._indices:
+            all_indices = self.client.get_validator_indices()
+            self._indices = {
+                pk: idx
+                for pk, idx in all_indices.items()
+                if pk in self.store.validators
+            }
+        return self._indices
+
+    def poll(self, epoch: int) -> None:
+        ours = set(self.validator_indices().values())
+        props = self.client.get_proposer_duties(epoch)
+        self.proposer[epoch] = [
+            d for d in props if d.validator_index in ours
+        ]
+        self.attester[epoch] = self.client.get_attester_duties(
+            epoch, sorted(ours)
+        )
+
+    def proposers_at(self, slot: int, epoch: int) -> list[ProposerDuty]:
+        return [d for d in self.proposer.get(epoch, []) if d.slot == slot]
+
+    def attesters_at(self, slot: int, epoch: int) -> list[AttesterDuty]:
+        return [d for d in self.attester.get(epoch, []) if d.slot == slot]
+
+
+class ValidatorClientContext:
+    """Shared per-VC context: spec, fork info from the BN."""
+
+    def __init__(self, client: BeaconNodeHttpClient, store: ValidatorStore):
+        self.client = client
+        self.store = store
+        genesis = client.get_genesis()
+        self.genesis = genesis
+        self.store.genesis_validators_root = genesis.genesis_validators_root
+
+    def fork_info(self) -> ForkInfo:
+        f = self.client.get_fork("head")
+        return ForkInfo(
+            fork=Fork(
+                previous_version=f["previous_version"],
+                current_version=f["current_version"],
+                epoch=f["epoch"],
+            ),
+            genesis_validators_root=self.genesis.genesis_validators_root,
+        )
+
+
+class AttestationService:
+    """Per-slot attestation duty execution (attestation_service.rs:231-507,
+    minus the aggregation phase which rides sign_selection_proof)."""
+
+    def __init__(self, ctx: ValidatorClientContext, duties: DutiesService):
+        self.ctx = ctx
+        self.duties = duties
+
+    def attest(self, slot: int) -> int:
+        """Sign + publish one attestation per owned attester duty at slot.
+        Returns the number published."""
+        spec = self.ctx.store.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        my = self.duties.attesters_at(slot, epoch)
+        if not my:
+            return 0
+        fork_info = self.ctx.fork_info()
+        ns = for_preset(spec.preset.name)
+        published = []
+        for duty in my:
+            data = AttestationData.decode(
+                self.ctx.client.get_attestation_data(slot, duty.committee_index)
+            )
+            sig = self.ctx.store.sign_attestation(duty.pubkey, data, fork_info)
+            bits = np.zeros(duty.committee_length, dtype=bool)
+            bits[duty.validator_committee_index] = True
+            att = ns.Attestation(
+                aggregation_bits=bits, data=data, signature=sig.serialize()
+            )
+            published.append(ns.Attestation.encode(att))
+        self.ctx.client.publish_attestations(published)
+        return len(published)
+
+
+class BlockService:
+    """Proposer duty execution (block_service.rs): randao sign -> produce via
+    BN -> sign -> publish."""
+
+    def __init__(self, ctx: ValidatorClientContext, duties: DutiesService):
+        self.ctx = ctx
+        self.duties = duties
+
+    def propose(self, slot: int) -> bool:
+        spec = self.ctx.store.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        my = self.duties.proposers_at(slot, epoch)
+        if not my:
+            return False
+        duty = my[0]
+        fork_info = self.ctx.fork_info()
+        randao = self.ctx.store.sign_randao(duty.pubkey, epoch, fork_info)
+        version, block_ssz = self.ctx.client.produce_block(
+            slot, randao.serialize()
+        )
+        ns = for_preset(spec.preset.name)
+        block_cls = ns.block_types[version]
+        inner_cls = dict(block_cls.FIELDS)["message"]
+        block = inner_cls.decode(block_ssz)
+        sig = self.ctx.store.sign_block(duty.pubkey, block, fork_info)
+        signed = block_cls(message=block, signature=sig.serialize())
+        self.ctx.client.publish_block(version, block_cls.encode(signed))
+        return True

@@ -1,0 +1,123 @@
+"""HTTP API + typed client + VC services: full loop over real HTTP.
+
+VERDICT round-1 item 7 done-criteria: a validator client attests AND proposes
+against a live beacon node through HTTP only (no shared objects beyond the
+genesis state both sides derive from).
+"""
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.api_client import ApiClientError, BeaconNodeHttpClient
+from lighthouse_tpu.beacon_chain.chain import BeaconChain
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.op_pool import OperationPool
+from lighthouse_tpu.state_transition.genesis import interop_secret_keys
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client.services import (
+    AttestationService,
+    BlockService,
+    DutiesService,
+    ValidatorClientContext,
+)
+from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def bn_vc():
+    spec = minimal_spec()
+    harness = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, harness.state.copy(), slot_clock=clock)
+    pool = OperationPool(spec, chain.ns.Attestation)
+    server = BeaconApiServer(chain, op_pool=pool).start()
+
+    client = BeaconNodeHttpClient(server.url)
+    store = ValidatorStore(spec)
+    for sk in interop_secret_keys(16):
+        store.add_validator_sk(bls.SecretKey.from_bytes(sk.to_bytes(32, "big")))
+    ctx = ValidatorClientContext(client, store)
+    duties = DutiesService(client, store)
+    yield spec, chain, clock, server, client, ctx, duties
+    server.stop()
+
+
+def test_node_endpoints(bn_vc):
+    _, chain, _, _, client, ctx, _ = bn_vc
+    assert ctx.genesis.genesis_time == 0
+    assert (
+        ctx.genesis.genesis_validators_root
+        == bytes(chain.genesis_state.genesis_validators_root)
+    )
+    syncing = client.get_syncing()
+    assert syncing["is_syncing"] in (False, True)
+    fc = client.get_finality_checkpoints()
+    assert fc["finalized"]["epoch"] == 0
+
+
+def test_vc_proposes_and_attests_over_http(bn_vc):
+    spec, chain, clock, _, client, ctx, duties = bn_vc
+    blocks_svc = BlockService(ctx, duties)
+    atts_svc = AttestationService(ctx, duties)
+
+    duties.poll(0)
+    assert duties.proposer[0], "proposer duties must exist"
+    assert duties.attester[0], "attester duties must exist"
+
+    for slot in range(1, 5):
+        clock.set_slot(slot)
+        assert blocks_svc.propose(slot), f"no proposal at slot {slot}"
+        assert atts_svc.attest(slot) > 0, f"no attestations at slot {slot}"
+
+    head = client.get_head_header()
+    assert head["slot"] == 4
+    assert chain.head.slot == 4
+    # attestations made it into blocks (op pool -> produce path)
+    total_included = sum(
+        len(sb.message.body.attestations) for sb in chain._blocks.values()
+    )
+    assert total_included > 0, "pool attestations never included in blocks"
+
+
+def test_slashing_protection_blocks_double_proposal(bn_vc):
+    spec, chain, clock, _, client, ctx, duties = bn_vc
+    from lighthouse_tpu.validator_client.slashing_protection import NotSafe
+
+    epoch = chain.head.slot // spec.preset.SLOTS_PER_EPOCH
+    duties.poll(epoch)
+    slot = chain.head.slot
+    props = duties.proposers_at(slot, epoch)
+    if not props:
+        pytest.skip("no owned proposer at current head slot")
+    duty = props[0]
+    fork_info = ctx.fork_info()
+    # the first proposal for this slot is already in the DB; signing a
+    # DIFFERENT block at the same slot must be refused
+    from lighthouse_tpu.types.containers import BeaconBlockHeader
+
+    fake = BeaconBlockHeader(slot=slot, proposer_index=duty.validator_index)
+    with pytest.raises(NotSafe):
+        ctx.store.sign_block(duty.pubkey, fake, fork_info)
+
+
+def test_bad_block_rejected_over_http(bn_vc):
+    spec, chain, clock, _, client, _, _ = bn_vc
+    version = spec.fork_name_at_epoch(0)
+    from lighthouse_tpu.types.containers import for_preset
+
+    ns = for_preset(spec.preset.name)
+    block_cls = ns.block_types[version]
+    garbage = block_cls()  # default block: wrong slot/parent/signature
+    with pytest.raises(ApiClientError) as ei:
+        client.publish_block(version, block_cls.encode(garbage))
+    assert ei.value.code == 400
