@@ -1,0 +1,20 @@
+"""Slashing detection with device min/max target arrays (ref slasher/).
+
+SURVEY.md §5 calls the reference's chunked 2D epoch x validator arrays "the
+closest thing to blockwise attention" in the codebase; this package is that
+workload rebuilt TPU-first — scatter + directional cumulative scans over
+whole validator-chunk tiles instead of per-validator epoch walk loops.
+"""
+
+from .config import MAX_DISTANCE, SlasherConfig
+from .db import SlasherDB
+from .service import SlasherService
+from .slasher import Slasher
+
+__all__ = [
+    "MAX_DISTANCE",
+    "Slasher",
+    "SlasherConfig",
+    "SlasherDB",
+    "SlasherService",
+]

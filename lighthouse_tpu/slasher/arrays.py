@@ -1,0 +1,231 @@
+"""Surround-vote min/max target arrays as fused device kernels.
+
+Reference semantics (slasher/src/array.rs): for every validator the slasher
+maintains, over a sliding window of ``history_length`` epochs,
+
+  ``min_targets[v][e]`` = min target of v's attestations with source >  e
+  ``max_targets[v][e]`` = max target of v's attestations with source <  e
+
+A new attestation ``X`` surrounds an existing one iff ``X.target >
+min_targets[v][X.source]`` and is surrounded iff ``X.target <
+max_targets[v][X.source]`` (array.rs:219-244, 322-347).  The reference
+maintains the invariant with per-validator epoch-by-epoch walk loops with
+early exit, tiled into 16-epoch chunks to bound I/O (array.rs:246-272,
+349-372).
+
+TPU redesign — the walk loops are really *interval* min/max updates whose
+intervals always extend to a window edge: attestation ``(s, t)`` applies
+``min`` over cells ``[window_start, s-1]`` and ``max`` over ``[s+1,
+current_epoch]``.  An entire batch therefore collapses to
+
+  1. scatter-min of ``t`` at column ``s-1`` (resp. scatter-max at ``s+1``),
+  2. one reverse (resp. forward) cumulative min (resp. max) scan along the
+     epoch axis,
+  3. an elementwise combine with the previous array.
+
+No per-attestation loop, no early exit, no chunk tiling: the unit of work is
+a whole ``[validator_chunk_size, history_length]`` row processed in one
+``jit``.  Slashability checks read the post-update arrays, which is
+order-safe because an attestation's own updates never touch the column its
+check reads (min writes cols ``< s``, max writes cols ``> s``, the check
+reads col ``s``); cross-attestation detections within a batch come out as a
+superset of the reference's sequential ones, and every flagged pair is
+re-confirmed host-side against the fetched record before a slashing is
+emitted.
+
+Storage is a linear window, newest epoch in the last column, encoded as
+``target - epoch`` distances in uint16 exactly like the reference
+(array.rs:14,84-99); distances are invariant under window shifts so epoch
+advance is a roll + neutral fill rather than a rewrite.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import MAX_DISTANCE
+
+_INT_INF = np.int32(2**31 - 1)
+
+
+def empty_row(validator_chunk_size: int, history_length: int):
+    """Fresh (min_d, max_d) distance tiles with neutral elements.
+
+    min neutral = MAX_DISTANCE (no attestation with source > e yet),
+    max neutral = 0 (ref array.rs:211-213, 314-316).
+    """
+    k, n = validator_chunk_size, history_length
+    return (
+        np.full((k, n), MAX_DISTANCE, dtype=np.uint16),
+        np.zeros((k, n), dtype=np.uint16),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _rows_update(min_d, max_d, delta, v_off, src, tgt, valid, cur, *, n):
+    """Advance + batch-update + check for a stack of validator-chunk rows.
+
+    min_d, max_d : uint16[R, K, N]   distance tiles (linear window layout)
+    delta        : int32[R]          window advance per row (cur - stored_epoch)
+    v_off, src, tgt : int32[R, P]    flattened (attestation x validator) pairs
+    valid        : bool[R, P]        padding mask
+    cur          : int32             current epoch (last column's epoch)
+
+    Returns (new_min_d, new_max_d, min_target, max_target, min_flag, max_flag)
+    where min_target/max_target are the per-pair post-update array reads used
+    by the host to fetch the existing attestation on a flagged surround.
+    """
+    base = cur - (n - 1)
+    e = base + jnp.arange(n, dtype=jnp.int32)  # epoch of each column
+
+    # -- 1. window advance: shift left by delta, neutral-fill the new columns.
+    j = jnp.arange(n, dtype=jnp.int32)
+
+    def shift(d, dl, neutral):
+        return jnp.where(j >= n - dl, neutral, jnp.roll(d, -dl, axis=-1))
+
+    min_d = jax.vmap(lambda d, dl: shift(d, dl, jnp.uint16(MAX_DISTANCE)))(
+        min_d, delta
+    )
+    max_d = jax.vmap(lambda d, dl: shift(d, dl, jnp.uint16(0)))(max_d, delta)
+
+    old_min_t = e[None, None, :] + min_d.astype(jnp.int32)
+    old_max_t = e[None, None, :] + max_d.astype(jnp.int32)
+    k = min_d.shape[1]
+
+    # -- 2. scatter + directional scan in the (int32) target domain.
+    # Invalid / out-of-window columns are routed to index n, which scatter
+    # mode="drop" discards.
+    col_min = jnp.where(valid, src - 1 - base, n)
+    col_min = jnp.where((col_min >= 0) & (col_min < n), col_min, n)
+    col_max = jnp.where(valid, src + 1 - base, n)
+    col_max = jnp.where((col_max >= 0) & (col_max < n), col_max, n)
+
+    def scatter_min_row(vo, cm, t):
+        z = jnp.full((k, n), _INT_INF, jnp.int32)
+        return z.at[vo, cm].min(t, mode="drop")
+
+    def scatter_max_row(vo, cm, t):
+        z = jnp.full((k, n), -_INT_INF, jnp.int32)
+        return z.at[vo, cm].max(t, mode="drop")
+
+    scat_min = jax.vmap(scatter_min_row)(v_off, col_min, tgt)
+    scat_max = jax.vmap(scatter_max_row)(v_off, col_max, tgt)
+
+    # min_targets[e] aggregates attestations with source-1 >= e: suffix scan.
+    suff_min = jax.lax.cummin(scat_min, axis=2, reverse=True)
+    # max_targets[e] aggregates attestations with source+1 <= e: prefix scan.
+    pref_max = jax.lax.cummax(scat_max, axis=2)
+
+    new_min_t = jnp.minimum(old_min_t, suff_min)
+    new_max_t = jnp.maximum(old_max_t, pref_max)
+
+    new_min_d = jnp.clip(new_min_t - e[None, None, :], 0, MAX_DISTANCE).astype(
+        jnp.uint16
+    )
+    new_max_d = jnp.clip(new_max_t - e[None, None, :], 0, MAX_DISTANCE).astype(
+        jnp.uint16
+    )
+
+    # -- 3. post-update reads at each pair's own source column.
+    col_s = jnp.clip(src - base, 0, n - 1)
+
+    def read_row(d, vo, cs):
+        return d[vo, cs]
+
+    min_target = jax.vmap(read_row)(new_min_d, v_off, col_s).astype(
+        jnp.int32
+    ) + jax.vmap(lambda cs: e[cs])(col_s)
+    max_target = jax.vmap(read_row)(new_max_d, v_off, col_s).astype(
+        jnp.int32
+    ) + jax.vmap(lambda cs: e[cs])(col_s)
+
+    min_flag = valid & (tgt > min_target)
+    max_flag = valid & (tgt < max_target)
+    return new_min_d, new_max_d, min_target, max_target, min_flag, max_flag
+
+
+def _bucket(x: int) -> int:
+    b = 8
+    while b < x:
+        b *= 2
+    return b
+
+
+_ROW_GROUP = 8  # rows per kernel launch: keeps launch shapes stable and
+#                 bounds the int32 working set (R x K x N x 4B per array)
+
+
+def update_rows(rows, pairs, current_epoch: int, history_length: int):
+    """Host wrapper: pad to shape buckets, run the kernel, unpad.
+
+    rows  : list of (stored_epoch, min_d u16[K,N], max_d u16[K,N])
+    pairs : list of list of (validator_offset, source, target) per row
+    Returns (new_rows, results) where new_rows is [(min_d, max_d)] and
+    results is per-row lists of (min_flag, min_target, max_flag, max_target)
+    aligned with the input pairs.
+
+    Launches are chunked to ``_ROW_GROUP`` rows so arbitrary batch spreads
+    (every row dirty at mainnet) reuse one compiled shape per pair-bucket.
+    """
+    if not rows:
+        return [], []
+    if len(rows) > _ROW_GROUP:
+        new_rows, results = [], []
+        for off in range(0, len(rows), _ROW_GROUP):
+            nr, res = update_rows(
+                rows[off : off + _ROW_GROUP],
+                pairs[off : off + _ROW_GROUP],
+                current_epoch,
+                history_length,
+            )
+            new_rows.extend(nr)
+            results.extend(res)
+        return new_rows, results
+    n_real = len(rows)
+    r = _ROW_GROUP if n_real > 1 else 1
+    p = _bucket(max(1, max(len(ps) for ps in pairs)))
+    if n_real < r:  # pad the last group to the fixed launch shape
+        rows = list(rows) + [
+            (current_epoch, rows[0][1], rows[0][2])
+        ] * (r - n_real)
+        pairs = list(pairs) + [[]] * (r - n_real)
+
+    min_d = np.stack([row[1] for row in rows])
+    max_d = np.stack([row[2] for row in rows])
+    delta = np.asarray(
+        [max(0, current_epoch - row[0]) for row in rows], dtype=np.int32
+    )
+    v_off = np.zeros((r, p), dtype=np.int32)
+    src = np.zeros((r, p), dtype=np.int32)
+    tgt = np.zeros((r, p), dtype=np.int32)
+    valid = np.zeros((r, p), dtype=bool)
+    for i, ps in enumerate(pairs):
+        for q, (vo, s, t) in enumerate(ps):
+            v_off[i, q], src[i, q], tgt[i, q], valid[i, q] = vo, s, t, True
+
+    out = _rows_update(
+        jnp.asarray(min_d),
+        jnp.asarray(max_d),
+        jnp.asarray(delta),
+        jnp.asarray(v_off),
+        jnp.asarray(src),
+        jnp.asarray(tgt),
+        jnp.asarray(valid),
+        jnp.int32(current_epoch),
+        n=history_length,
+    )
+    new_min, new_max, min_t, max_t, min_f, max_f = (np.asarray(o) for o in out)
+    new_rows = [(new_min[i], new_max[i]) for i in range(n_real)]
+    results = [
+        [
+            (bool(min_f[i, q]), int(min_t[i, q]), bool(max_f[i, q]), int(max_t[i, q]))
+            for q in range(len(ps))
+        ]
+        for i, ps in enumerate(pairs[:n_real])
+    ]
+    return new_rows, results

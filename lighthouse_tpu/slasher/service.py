@@ -1,0 +1,58 @@
+"""Slasher service: gossip ingest + periodic batch processing
+(ref slasher/service/src/service.rs).
+
+The reference spawns a timer task that runs ``process_queued`` every
+``update_period`` at ``slot_offset`` into the slot, then drains found
+slashings into the op pool and optionally broadcasts them.  Here the service
+exposes the same three edges — observe attestation, observe block, tick —
+and the node/test driver supplies the clock.
+"""
+
+from __future__ import annotations
+
+
+class SlasherService:
+    def __init__(self, chain, slasher, op_pool=None):
+        self.chain = chain
+        self.slasher = slasher
+        self.op_pool = op_pool if op_pool is not None else getattr(
+            chain, "op_pool", None
+        )
+
+    # -- ingest edges ---------------------------------------------------------
+
+    def attestation_observed(self, indexed_attestation) -> None:
+        """Feed a gossip-verified indexed attestation (service.rs ingest)."""
+        self.slasher.accept_attestation(indexed_attestation)
+
+    def block_observed(self, signed_block) -> None:
+        """Feed an imported block's signed header."""
+        from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
+
+        blk = signed_block.message
+        header = SignedBeaconBlockHeader(
+            message=BeaconBlockHeader(
+                slot=blk.slot,
+                proposer_index=blk.proposer_index,
+                parent_root=bytes(blk.parent_root),
+                state_root=bytes(blk.state_root),
+                body_root=type(blk.body).hash_tree_root(blk.body),
+            ),
+            signature=bytes(signed_block.signature),
+        )
+        self.slasher.accept_block_header(header)
+
+    # -- periodic processing --------------------------------------------------
+
+    def tick(self, current_epoch: int | None = None) -> dict:
+        """Process queues and drain slashings into the op pool."""
+        if current_epoch is None:
+            spe = self.chain.spec.preset.SLOTS_PER_EPOCH
+            current_epoch = self.chain.current_slot() // spe
+        stats = self.slasher.process_queued(current_epoch)
+        if self.op_pool is not None:
+            for s in self.slasher.get_attester_slashings():
+                self.op_pool.insert_attester_slashing(s)
+            for s in self.slasher.get_proposer_slashings():
+                self.op_pool.insert_proposer_slashing(s)
+        return stats

@@ -27,6 +27,13 @@ class DBColumn(enum.Enum):
     ColdState = b"cst"
     ColdStateDiff = b"cdf"
     Metadata = b"met"
+    # slasher (slasher/src/database.rs database table names)
+    SlasherTargets = b"stg"
+    SlasherAttesterRecords = b"sar"
+    SlasherIndexedAtts = b"sia"
+    SlasherAttIdByHash = b"sih"
+    SlasherProposals = b"spr"
+    SlasherMeta = b"smt"
 
 
 class KeyValueStore:
