@@ -95,6 +95,11 @@ class BeaconChain:
     def current_slot(self) -> int:
         return self.slot_clock.now() or 0
 
+    def state_by_root(self, block_root: bytes):
+        """Post-state of an imported block, or None (public accessor for the
+        API layer; insulates callers from the chain's state-cache layout)."""
+        return self._states.get(block_root)
+
     # -- block import pipeline -----------------------------------------------------
 
     def get_state_for_block(self, parent_root: bytes, slot: int):

@@ -50,6 +50,10 @@ class BeaconApiServer:
         self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        # The chain is not safe under concurrent mutation; handler threads
+        # serialize here (the reference serializes through the beacon
+        # processor's ApiRequestP0/P1 queues instead).
+        self._chain_lock = threading.RLock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -71,8 +75,21 @@ class BeaconApiServer:
     # -- state resolution --------------------------------------------------
 
     def _state(self, state_id: str):
-        if state_id in ("head", "justified", "finalized"):
-            st = self.chain.head.state
+        if state_id == "head":
+            return self.chain.head.state
+        if state_id in ("justified", "finalized"):
+            head = self.chain.head.state
+            cp = (
+                head.current_justified_checkpoint
+                if state_id == "justified"
+                else head.finalized_checkpoint
+            )
+            root = bytes(cp.root)
+            if root == b"\x00" * 32:  # pre-genesis-justification alias
+                root = self.chain.genesis_block_root
+            st = self.chain.state_by_root(root)
+            if st is None:
+                raise ApiError(404, f"{state_id} state not held: {root.hex()}")
             return st
         raise ApiError(400, f"unsupported state id {state_id!r}")
 
@@ -139,9 +156,7 @@ class BeaconApiServer:
             process_slots(spec, state, start)
         duties = []
         for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
-            if state.slot < slot:
-                process_slots(spec, state, slot)
-            idx = get_beacon_proposer_index(spec, state)
+            idx = get_beacon_proposer_index(spec, state, slot=slot)
             duties.append(
                 {
                     "pubkey": _hex(state.validators[idx].pubkey),
@@ -159,8 +174,9 @@ class BeaconApiServer:
             process_slots(spec, state, start)
         wanted = set(indices)
         duties = []
+        committees_per_slot = get_committee_count_per_slot(spec, state, epoch)
         for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
-            for index in range(get_committee_count_per_slot(spec, state, epoch)):
+            for index in range(committees_per_slot):
                 committee = get_beacon_committee(spec, state, slot, index)
                 for pos, v in enumerate(committee):
                     if int(v) in wanted:
@@ -170,9 +186,7 @@ class BeaconApiServer:
                                 "validator_index": str(int(v)),
                                 "committee_index": str(index),
                                 "committee_length": str(committee.size),
-                                "committees_at_slot": str(
-                                    get_committee_count_per_slot(spec, state, epoch)
-                                ),
+                                "committees_at_slot": str(committees_per_slot),
                                 "validator_committee_index": str(pos),
                                 "slot": str(slot),
                             }
@@ -207,11 +221,10 @@ class BeaconApiServer:
 
     def produce_block(self, slot: int, randao_reveal: bytes, graffiti: bytes):
         chain = self.chain
-        atts = self.op_pool.get_attestations(
-            _advanced(chain, slot)
-        ) if self.op_pool else []
+        state = _advanced(chain, slot)  # advance once; shared by pool + production
+        atts = self.op_pool.get_attestations(state) if self.op_pool else []
         block, _post = chain.produce_block_on_state(
-            chain.head.state, slot, randao_reveal, attestations=atts,
+            state, slot, randao_reveal, attestations=atts,
             graffiti=graffiti or b"\x00" * 32,
         )
         fork = chain.spec.fork_name_at_epoch(
@@ -328,7 +341,8 @@ def _make_handler(api: BeaconApiServer):
                     if not match:
                         continue
                     q = {k: v[0] for k, v in parse_qs(u.query).items()}
-                    out = self._route(name, match, q)
+                    with api._chain_lock:
+                        out = self._route(name, match, q)
                     self._reply(200, {"data": out} if name != "produce_block" else out)
                     return
                 self._reply(404, {"message": f"no route {u.path}"})

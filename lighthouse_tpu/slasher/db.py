@@ -50,11 +50,13 @@ class SlasherDB:
 
     # -- indexed attestations -------------------------------------------------
 
-    def store_indexed_attestation(self, att) -> int:
+    def store_indexed_attestation(self, att, root: bytes | None = None) -> int:
         """Dedup by hash-tree-root; returns the attestation id
-        (ref database.rs store_indexed_attestation)."""
+        (ref database.rs store_indexed_attestation). Pass ``root`` when the
+        caller already hashed the attestation to avoid re-hashing."""
         t = type(att)
-        root = t.hash_tree_root(att)
+        if root is None:
+            root = t.hash_tree_root(att)
         with self._lock:
             existing = self.store.get(DBColumn.SlasherAttIdByHash, root)
             if existing is not None:
@@ -183,7 +185,6 @@ class SlasherDB:
             dirty = [
                 (rid, self._row_cache[rid]) for rid in sorted(self._dirty_rows)
             ]
-            self._dirty_rows.clear()
         ops = []
         for rid, (epoch, min_d, max_d) in dirty:
             zmin = zlib.compress(np.ascontiguousarray(min_d).tobytes(), 1)
@@ -198,11 +199,15 @@ class SlasherDB:
             )
         if ops:
             self.store.do_atomically(ops)
+        # only forget dirtiness once the write has succeeded — a failed
+        # flush must stay retryable or detections silently stop persisting
+        with self._lock:
+            self._dirty_rows.difference_update(rid for rid, _ in dirty)
         return len(ops)
 
     # -- pruning --------------------------------------------------------------
 
-    def prune(self, current_epoch: int, slots_per_epoch: int = 32) -> int:
+    def prune(self, current_epoch: int, slots_per_epoch: int) -> int:
         """Drop attester records / attestations / proposals older than the
         history window (ref database.rs prune)."""
         min_epoch = max(0, current_epoch - self.config.history_length + 1)

@@ -18,6 +18,7 @@ class SlasherService:
         self.op_pool = op_pool if op_pool is not None else getattr(
             chain, "op_pool", None
         )
+        self._last_pruned_epoch = -1
 
     # -- ingest edges ---------------------------------------------------------
 
@@ -45,11 +46,15 @@ class SlasherService:
     # -- periodic processing --------------------------------------------------
 
     def tick(self, current_epoch: int | None = None) -> dict:
-        """Process queues and drain slashings into the op pool."""
+        """Process queues and drain slashings into the op pool; prunes the
+        database once per epoch advance (service.rs prune cadence)."""
+        spe = self.chain.spec.preset.SLOTS_PER_EPOCH
         if current_epoch is None:
-            spe = self.chain.spec.preset.SLOTS_PER_EPOCH
             current_epoch = self.chain.current_slot() // spe
         stats = self.slasher.process_queued(current_epoch)
+        if current_epoch > self._last_pruned_epoch:
+            self.slasher.prune_database(current_epoch, spe)
+            self._last_pruned_epoch = current_epoch
         if self.op_pool is not None:
             for s in self.slasher.get_attester_slashings():
                 self.op_pool.insert_attester_slashing(s)

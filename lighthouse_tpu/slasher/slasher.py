@@ -89,7 +89,14 @@ class Slasher:
         return found
 
     def _validate(self, atts, current_epoch: int):
-        """Split into (keep, deferred, dropped) — ref slasher.rs:336-368."""
+        """Split into (keep, deferred, dropped) — ref slasher.rs:336-368.
+
+        Note the drop window is keyed on SOURCE epoch, matching the
+        reference (slasher.rs:350-352): the min/max arrays only cover
+        ``history_length`` epochs, so an attestation whose source has left
+        the window cannot be recorded — bounded memory is the design
+        trade-off, not an oversight.
+        """
         keep, defer, dropped = [], [], 0
         for att in atts:
             src = int(att.data.source.epoch)
@@ -116,7 +123,7 @@ class Slasher:
             if root in seen:
                 continue
             seen.add(root)
-            att_id = self.db.store_indexed_attestation(att)
+            att_id = self.db.store_indexed_attestation(att, root=root)
             data_root = AttestationData.hash_tree_root(att.data)
             batch.append((att, data_root, att_id))
 
@@ -215,5 +222,5 @@ class Slasher:
                 found += 1
         return found
 
-    def prune_database(self, current_epoch: int) -> int:
-        return self.db.prune(current_epoch)
+    def prune_database(self, current_epoch: int, slots_per_epoch: int) -> int:
+        return self.db.prune(current_epoch, slots_per_epoch)

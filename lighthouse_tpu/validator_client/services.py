@@ -40,7 +40,9 @@ class DutiesService:
         self.attester: dict[int, list[AttesterDuty]] = {}
 
     def validator_indices(self) -> dict[bytes, int]:
-        if not self._indices:
+        # Re-poll while any managed key is still unresolved — validators can
+        # activate after the first poll (duties_service.rs re-polls per cycle).
+        if len(self._indices) < len(self.store.validators):
             all_indices = self.client.get_validator_indices()
             self._indices = {
                 pk: idx

@@ -234,7 +234,7 @@ class TestSlasher:
         s = self._slasher(history_length=64)
         s.accept_attestation(_att([1], 4, 5))
         s.process_queued(6)
-        dropped = s.prune_database(500)
+        dropped = s.prune_database(500, 8)
         assert dropped >= 1
 
     def test_16k_validators(self):
@@ -293,8 +293,11 @@ class TestService:
         slasher = Slasher(MemoryStore(), NS, cfg)
         pool = PoolStub()
 
+        from lighthouse_tpu.types.spec import minimal_spec
+
         class ChainStub:
             op_pool = pool
+            spec = minimal_spec()
 
         svc = SlasherService(ChainStub(), slasher, pool)
         svc.attestation_observed(_att([3], 10, 11))
