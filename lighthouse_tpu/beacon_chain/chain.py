@@ -83,6 +83,13 @@ class BeaconChain:
             jc,
             np.asarray(genesis_state.balances, dtype=np.uint64),
         )
+        # Serializes chain mutation across whatever threads drive this chain
+        # (HTTP handlers, network router, simulator loops). The reference
+        # reaches the same guarantee through canonical_head's documented
+        # lock ordering (canonical_head.rs module docs).
+        import threading
+
+        self.lock = threading.RLock()
         self._states: dict[bytes, object] = {genesis_root: genesis_state}
         self._blocks: dict[bytes, object] = {}
         self.head = ChainHead(
@@ -116,6 +123,14 @@ class BeaconChain:
         fork choice. Returns the block root."""
         block = signed_block.message
         block_root = type(block).hash_tree_root(block)
+        with self.lock:
+            return self._process_block_locked(
+                signed_block, block, block_root, is_first_block_in_slot
+            )
+
+    def _process_block_locked(
+        self, signed_block, block, block_root, is_first_block_in_slot
+    ) -> bytes:
         if block_root in self._seen_blocks:
             return block_root
         if block.slot > self.current_slot():
@@ -168,11 +183,15 @@ class BeaconChain:
         """Batch-verify ALL signatures of a segment in one bls call, then
         apply blocks with NoVerification (signature_verify_chain_segment,
         block_verification.rs:590-636)."""
-        from ..state_transition.per_block import BlockSignatureVerifier
-
         roots = []
         if not blocks:
             return roots
+        with self.lock:
+            return self._process_chain_segment_locked(blocks, roots)
+
+    def _process_chain_segment_locked(self, blocks, roots) -> list:
+        from ..state_transition.per_block import BlockSignatureVerifier
+
         # thread ONE state through the segment: collect each block's signature
         # sets against its pre-state, apply the transition unverified, and
         # only import after the whole segment's batch verifies
@@ -335,12 +354,15 @@ class BeaconChain:
                     results.append(
                         (att, AttestationError("invalid attestation signature"))
                     )
-        for att, indexed in results:
-            if not isinstance(indexed, Exception):
-                try:
-                    self.fork_choice.on_attestation(self.current_slot(), indexed)
-                except Exception:
-                    pass
+        with self.lock:
+            for att, indexed in results:
+                if not isinstance(indexed, Exception):
+                    try:
+                        self.fork_choice.on_attestation(
+                            self.current_slot(), indexed
+                        )
+                    except Exception:
+                        pass
         return results
 
     def verify_aggregated_attestations(self, signed_aggregates) -> list:
@@ -400,12 +422,15 @@ class BeaconChain:
                     results.append(
                         (sap, AttestationError("invalid aggregate signature"))
                     )
-        for sap, indexed in results:
-            if not isinstance(indexed, Exception):
-                try:
-                    self.fork_choice.on_attestation(self.current_slot(), indexed)
-                except Exception:
-                    pass
+        with self.lock:
+            for sap, indexed in results:
+                if not isinstance(indexed, Exception):
+                    try:
+                        self.fork_choice.on_attestation(
+                            self.current_slot(), indexed
+                        )
+                    except Exception:
+                        pass
         return results
 
     def _attestation_state(self, att):
@@ -421,6 +446,10 @@ class BeaconChain:
     # -- head ------------------------------------------------------------------------
 
     def recompute_head(self) -> bytes:
+        with self.lock:
+            return self._recompute_head_locked()
+
+    def _recompute_head_locked(self) -> bytes:
         head_root = self.fork_choice.get_head(self.current_slot())
         if head_root != self.head.root:
             state = self._states.get(head_root)

@@ -50,10 +50,10 @@ class BeaconApiServer:
         self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
-        # The chain is not safe under concurrent mutation; handler threads
-        # serialize here (the reference serializes through the beacon
-        # processor's ApiRequestP0/P1 queues instead).
-        self._chain_lock = threading.RLock()
+        # Share the CHAIN's mutation lock so handler threads serialize
+        # against every other driver of this chain (network router,
+        # simulator loops), not just each other.
+        self._chain_lock = chain.lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -90,6 +90,12 @@ class BeaconApiServer:
             st = self.chain.state_by_root(root)
             if st is None:
                 raise ApiError(404, f"{state_id} state not held: {root.hex()}")
+            # the checkpoint block can predate its epoch start (skipped
+            # slots); the checkpoint STATE is advanced to the boundary
+            boundary = self.chain.spec.start_slot(int(cp.epoch))
+            if st.slot < boundary:
+                st = st.copy()
+                process_slots(self.chain.spec, st, boundary)
             return st
         raise ApiError(400, f"unsupported state id {state_id!r}")
 

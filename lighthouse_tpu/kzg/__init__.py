@@ -1,0 +1,29 @@
+"""KZG commitments (ref crypto/kzg): blob commitments, proofs, batch verify
+on the framework's own BLS12-381 stack with a backend-pluggable MSM."""
+
+from .kzg import (
+    BYTES_PER_BLOB,
+    BYTES_PER_COMMITMENT,
+    BYTES_PER_FIELD_ELEMENT,
+    BYTES_PER_PROOF,
+    FIELD_ELEMENTS_PER_BLOB,
+    Kzg,
+    KzgError,
+    VERSIONED_HASH_VERSION_KZG,
+    kzg_commitment_to_versioned_hash,
+)
+from .setup import TrustedSetup, load as load_trusted_setup
+
+__all__ = [
+    "BYTES_PER_BLOB",
+    "BYTES_PER_COMMITMENT",
+    "BYTES_PER_FIELD_ELEMENT",
+    "BYTES_PER_PROOF",
+    "FIELD_ELEMENTS_PER_BLOB",
+    "Kzg",
+    "KzgError",
+    "TrustedSetup",
+    "VERSIONED_HASH_VERSION_KZG",
+    "kzg_commitment_to_versioned_hash",
+    "load_trusted_setup",
+]

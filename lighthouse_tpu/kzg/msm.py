@@ -1,0 +1,86 @@
+"""G1 multi-scalar multiplication over the trusted-setup points.
+
+Backend seam mirrors ``lighthouse_tpu.bls``: the oracle path uses the
+pure-Python Pippenger (ops/bls_oracle/curves.g1_msm); the device path keeps
+the setup resident as a ``[N, 3, 25]`` limb array (one-time upload, the
+KZG analog of the device pubkey cache) and runs the whole MSM as one
+255-step double-and-add scan over all N lanes followed by a tree reduce —
+shape-stable, no per-call H2D beyond the 255x N bit matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.bls_oracle import curves as oc
+from ..ops.bls_oracle.fields import R
+
+_SCALAR_BITS = 255
+
+_device_setups: dict[int, object] = {}
+
+
+def _device_points(points):
+    # keyed by identity; the cache entry pins the host list so the id can't
+    # be recycled by the allocator
+    entry = _device_setups.get(id(points))
+    if entry is None:
+        from ..ops.bls import g1 as dg1
+
+        entry = (points, dg1.from_oracle_batch(points))
+        _device_setups[id(points)] = entry
+    return entry[1]
+
+
+def pippenger(points, scalars, window: int = 8):
+    """Host bucket MSM: ceil(255/w) windows of bucket-accumulate + sweep,
+    all in Jacobian coordinates (one affine normalization at the end).
+
+    ~6x fewer group ops than per-scalar double-and-add at blob size; the
+    oracle's naive g1_msm stays as the differential-testing reference."""
+    ops = oc.OPS_FQ
+    sc = [int(s) % R for s in scalars]
+    jac = [oc._to_jac(p, ops) if p is not None else None for p in points]
+    n_windows = (_SCALAR_BITS + window - 1) // window
+    acc = None
+    for wi in range(n_windows - 1, -1, -1):
+        if acc is not None:
+            for _ in range(window):
+                acc = oc._jac_double(acc, ops)
+        shift = wi * window
+        buckets = [None] * (1 << window)
+        for p, s in zip(jac, sc):
+            d = (s >> shift) & ((1 << window) - 1)
+            if d:
+                buckets[d] = oc._jac_add(buckets[d], p, ops)
+        running, win_sum = None, None
+        for b in range(len(buckets) - 1, 0, -1):
+            running = oc._jac_add(running, buckets[b], ops)
+            win_sum = oc._jac_add(win_sum, running, ops)
+        acc = oc._jac_add(acc, win_sum, ops)
+    return oc._to_affine(acc, ops)
+
+
+def msm(points, scalars, backend: str | None = None):
+    """sum scalars[i] * points[i] (oracle affine in, oracle affine out)."""
+    from .. import bls
+
+    backend = backend or bls.get_backend()
+    if backend != "tpu":
+        return pippenger(points, scalars)
+
+    import jax.numpy as jnp
+
+    from ..ops.bls import g1 as dg1
+
+    dev = _device_points(points)
+    raw = b"".join((int(s) % R).to_bytes(32, "big") for s in scalars)
+    all_bits = np.unpackbits(
+        np.frombuffer(raw, dtype=np.uint8).reshape(len(scalars), 32), axis=1
+    )
+    # 256-bit rows, scalars < 2^255: drop the always-zero top bit, MSB first
+    bits = all_bits[:, 256 - _SCALAR_BITS :].T.astype(np.uint64)
+    from ..ops.bls import curve
+
+    scaled = curve.scale_bits(dg1.K, dev, jnp.asarray(bits))
+    return dg1.to_oracle(dg1.psum(scaled))

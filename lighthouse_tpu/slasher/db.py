@@ -200,9 +200,12 @@ class SlasherDB:
         if ops:
             self.store.do_atomically(ops)
         # only forget dirtiness once the write has succeeded — a failed
-        # flush must stay retryable or detections silently stop persisting
+        # flush must stay retryable — and only for rows not re-dirtied
+        # while the write ran unlocked (identity check against the snapshot)
         with self._lock:
-            self._dirty_rows.difference_update(rid for rid, _ in dirty)
+            for rid, row in dirty:
+                if self._row_cache.get(rid) is row:
+                    self._dirty_rows.discard(rid)
         return len(ops)
 
     # -- pruning --------------------------------------------------------------
