@@ -38,6 +38,15 @@ class BlockError(Exception):
     pass
 
 
+class BlockPendingAvailability(BlockError):
+    """Deneb block parked until its blob sidecars arrive
+    (AvailabilityProcessingStatus::MissingComponents)."""
+
+    def __init__(self, block_root: bytes):
+        super().__init__(f"pending blob availability: {block_root.hex()[:16]}")
+        self.block_root = block_root
+
+
 class AttestationError(Exception):
     pass
 
@@ -57,12 +66,18 @@ class BeaconChain:
         store: HotColdDB | None = None,
         slot_clock: SlotClock | None = None,
         execution_layer=None,
+        kzg=None,
     ):
         self.spec = spec
         self.ns = for_preset(spec.preset.name)
         self.store = store or HotColdDB()
         self.slot_clock = slot_clock or ManualSlotClock(0)
         self.execution_layer = execution_layer
+        from .data_availability import DataAvailabilityChecker
+
+        self.da_checker = DataAvailabilityChecker(
+            spec, kzg=kzg, is_known=lambda root: root in self._seen_blocks
+        )
         self.pubkey_cache = ValidatorPubkeyCache()
         self.pubkey_cache.import_new_pubkeys(genesis_state)
 
@@ -129,12 +144,21 @@ class BeaconChain:
             )
 
     def _process_block_locked(
-        self, signed_block, block, block_root, is_first_block_in_slot
+        self,
+        signed_block,
+        block,
+        block_root,
+        is_first_block_in_slot,
+        check_availability: bool = True,
     ) -> bytes:
         if block_root in self._seen_blocks:
             return block_root
         if block.slot > self.current_slot():
             raise BlockError("block from the future")
+        if check_availability and self.da_checker._required(signed_block):
+            res = self.da_checker.put_block(block_root, signed_block)
+            if res is None:
+                raise BlockPendingAvailability(block_root)
 
         state = self.get_state_for_block(bytes(block.parent_root), block.slot)
         ctxt = ConsensusContext()
@@ -158,6 +182,34 @@ class BeaconChain:
         )
         return block_root
 
+    def process_gossip_blob(self, sidecar) -> bytes | None:
+        """Verify a gossiped BlobSidecar and, if it completes a parked
+        block's blob set, import that block. Returns the imported block
+        root, or None while components are still missing
+        (process_gossip_blob -> process_availability in the reference)."""
+        from ..state_transition.signature_sets import _header_signature_ok
+        from ..types.containers import BeaconBlockHeader
+
+        ns = self.ns
+        self.da_checker.verify_blob_sidecar(ns, sidecar)
+        hdr = sidecar.signed_block_header
+        proposer_pk = self.pubkey_cache.get(int(hdr.message.proposer_index))
+        if proposer_pk is None or not _header_signature_ok(
+            self.spec, self.head.state, hdr, proposer_pk
+        ):
+            from .data_availability import BlobError
+
+            raise BlobError("invalid blob header signature")
+        res = self.da_checker.put_blob(sidecar)
+        if res is None:
+            return None
+        blk, _blobs = res
+        root = BeaconBlockHeader.hash_tree_root(hdr.message)
+        with self.lock:
+            return self._process_block_locked(
+                blk, blk.message, root, True, check_availability=False
+            )
+
     def _notify_execution_layer(self, signed_block):
         """engine_newPayload for merge-era blocks; maps the EL verdict onto
         fork choice's optimistic-sync statuses (block_verification.rs
@@ -179,18 +231,49 @@ class BeaconChain:
             return ExecutionStatus.OPTIMISTIC
         raise BlockError(f"execution payload invalid: {st.validation_error}")
 
-    def process_chain_segment(self, blocks) -> list:
+    def process_chain_segment(self, blocks, blobs_by_root=None) -> list:
         """Batch-verify ALL signatures of a segment in one bls call, then
         apply blocks with NoVerification (signature_verify_chain_segment,
-        block_verification.rs:590-636)."""
+        block_verification.rs:590-636).
+
+        ``blobs_by_root``: {block_root: [BlobSidecar]} for deneb segments —
+        range sync couples blob downloads with block downloads (the
+        reference's block_sidecar_coupling.rs); a block whose commitments
+        have no matching verified sidecars here fails availability."""
         roots = []
         if not blocks:
             return roots
         with self.lock:
-            return self._process_chain_segment_locked(blocks, roots)
+            return self._process_chain_segment_locked(
+                blocks, roots, blobs_by_root or {}
+            )
 
-    def _process_chain_segment_locked(self, blocks, roots) -> list:
+    def _check_segment_availability(self, sb, block_root, blobs_by_root):
+        """Deneb: segment blocks with commitments need their sidecars
+        verified (KZG batch + inclusion proofs) before import."""
+        required = self.da_checker._required(sb)
+        if required == 0:
+            return
+        from .data_availability import BlobError
+
+        sidecars = blobs_by_root.get(block_root)
+        if sidecars is None or len(sidecars) < required:
+            raise BlockPendingAvailability(block_root)
+        self.da_checker.verify_blob_sidecar_batch(self.ns, sidecars)
+        comms = sb.message.body.blob_kzg_commitments
+        by_index = {int(sc.index): sc for sc in sidecars}
+        for i in range(required):
+            sc = by_index.get(i)
+            if sc is None or bytes(sc.kzg_commitment) != bytes(comms[i]):
+                raise BlobError(f"segment blob {i} missing or mismatched")
+
+    def _process_chain_segment_locked(self, blocks, roots, blobs_by_root) -> list:
         from ..state_transition.per_block import BlockSignatureVerifier
+
+        # deneb availability first: fail the segment before any expensive work
+        for sb in blocks:
+            block_root = type(sb.message).hash_tree_root(sb.message)
+            self._check_segment_availability(sb, block_root, blobs_by_root)
 
         # thread ONE state through the segment: collect each block's signature
         # sets against its pre-state, apply the transition unverified, and

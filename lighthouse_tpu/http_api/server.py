@@ -249,14 +249,37 @@ class BeaconApiServer:
         )
         block_cls = self.chain.ns.block_types[fork]
         signed = block_cls.decode(_unhex(body["data"]))
-        from ..beacon_chain.chain import BlockError
+        from ..beacon_chain.chain import BlockError, BlockPendingAvailability
 
+        # deneb BlockContents: blobs + proofs ride alongside the block
+        sidecars = []
+        if body.get("blobs"):
+            from ..beacon_chain.data_availability import make_blob_sidecars
+
+            sidecars = make_blob_sidecars(
+                self.chain.ns,
+                signed,
+                [_unhex(x) for x in body["blobs"]],
+                [_unhex(x) for x in body.get("kzg_proofs", [])],
+            )
         try:
             self.chain.process_block(signed)
+        except BlockPendingAvailability:
+            imported = None
+            for sc in sidecars:
+                imported = self.chain.process_gossip_blob(sc)
+            if imported is None:
+                raise ApiError(
+                    400, "block pending blob availability"
+                ) from None
         except BlockError as e:
             raise ApiError(400, str(e)) from None
         if self.network is not None:
             self.network.publish_block(signed)
+            publish_blob = getattr(self.network, "publish_blob", None)
+            if publish_blob is not None:
+                for sc in sidecars:
+                    publish_blob(sc)
         return {}
 
     def publish_attestations(self, body: list):

@@ -116,16 +116,9 @@ class Kzg:
                 if i == m:
                     continue
                 q[i] = (f - y) % r * inv_d[i] % r
-                # q_m += (f_i - y) * w_i / (w_m * (w_m - w_i))
-                q[m] = (
-                    q[m]
-                    + (f - y)
-                    * w
-                    % r
-                    * pow((roots[m] - w) % r, r - 2, r)
-                    % r
-                    * inv_wm
-                ) % r
+                # q_m += (f_i - y) * w_i / (w_m * (w_m - w_i));
+                # 1/(w_m - w_i) = -inv_d[i] since z = w_m
+                q[m] = (q[m] + (f - y) * w % r * (-inv_d[i]) % r * inv_wm) % r
         else:
             denoms = [(w - z) % r for w in roots]
             inv_d = fr.batch_inverse(denoms)

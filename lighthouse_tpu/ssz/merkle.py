@@ -49,6 +49,51 @@ def merkleize_chunks(chunks: np.ndarray, limit: int | None = None) -> bytes:
     return bytes(level[0])
 
 
+def merkle_branch_from_chunks(
+    chunks: np.ndarray, limit: int, index: int
+) -> list[bytes]:
+    """Sibling branch (bottom-up) for leaf ``index`` in the padded tree that
+    ``merkleize_chunks(chunks, limit)`` roots — proof *generation*, the
+    counterpart of ``is_valid_merkle_branch`` (ref merkle_proof's
+    ``MerkleTree::generate_proof``; needed by BlobSidecar inclusion proofs
+    and the light-client server)."""
+    chunks = np.asarray(chunks, dtype=np.uint8).reshape(-1, 32)
+    depth = (next_pow2(max(limit, 1)) - 1).bit_length()
+    branch: list[bytes] = []
+    level = chunks
+    idx = index
+    for d in range(depth):
+        sib = idx ^ 1
+        branch.append(
+            bytes(level[sib]) if sib < level.shape[0] else bytes(ZERO_HASHES[d])
+        )
+        m = level.shape[0]
+        if m % 2:
+            level = np.concatenate([level, ZERO_HASHES[d][None, :]], axis=0)
+            m += 1
+        level = (
+            sha256_pairs(level.reshape(m // 2, 64))
+            if m
+            else np.zeros((0, 32), np.uint8)
+        )
+        idx //= 2
+    return branch
+
+
+def fold_merkle_branch(leaf: bytes, branch: list[bytes], index: int) -> bytes:
+    """Root implied by a leaf + sibling branch (direction bits from index)."""
+    node = np.frombuffer(leaf, dtype=np.uint8)
+    for i, sib in enumerate(branch):
+        s = np.frombuffer(sib, dtype=np.uint8)
+        pair = (
+            np.concatenate([s, node])
+            if (index >> i) & 1
+            else np.concatenate([node, s])
+        )
+        node = sha256_pairs(pair[None, :])[0]
+    return bytes(node)
+
+
 def mix_in_length(root: bytes, length: int) -> bytes:
     block = np.zeros(64, dtype=np.uint8)
     block[:32] = np.frombuffer(root, dtype=np.uint8)

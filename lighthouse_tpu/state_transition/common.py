@@ -38,6 +38,16 @@ def get_validator_churn_limit(spec: ChainSpec, state) -> int:
     )
 
 
+def get_validator_activation_churn_limit(spec: ChainSpec, state) -> int:
+    """Deneb caps the activation churn (spec get_validator_activation_churn_limit)."""
+    from ..types.spec import fork_at_least
+
+    limit = get_validator_churn_limit(spec, state)
+    if fork_at_least(getattr(state, "fork_name", "phase0"), "deneb"):
+        limit = min(spec.max_per_epoch_activation_churn_limit, limit)
+    return limit
+
+
 def compute_activation_exit_epoch(spec: ChainSpec, epoch: int) -> int:
     return epoch + 1 + spec.max_seed_lookahead
 

@@ -269,6 +269,12 @@ def per_block_processing(
 
     process_block_header(spec, state, block, ctxt)
     fork = getattr(state, "fork_name", "phase0")
+    commitments = getattr(block.body, "blob_kzg_commitments", None)
+    if commitments is not None and len(commitments) > spec.preset.MAX_BLOBS_PER_BLOCK:
+        raise BlockProcessingError(
+            f"{len(commitments)} blob commitments exceeds "
+            f"MAX_BLOBS_PER_BLOCK {spec.preset.MAX_BLOBS_PER_BLOCK}"
+        )
     payload = getattr(block.body, "execution_payload", None)
     if payload is not None and is_execution_enabled(state, payload):
         if fork_at_least(fork, "capella"):

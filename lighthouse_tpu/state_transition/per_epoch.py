@@ -305,9 +305,9 @@ def process_registry_updates(spec, state, cols: _Cols):
         ),
         key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
     )
-    from .common import get_validator_churn_limit
+    from .common import get_validator_activation_churn_limit
 
-    for i in queue[: get_validator_churn_limit(spec, state)]:
+    for i in queue[: get_validator_activation_churn_limit(spec, state)]:
         state.validators[i].activation_epoch = compute_activation_exit_epoch(
             spec, cur
         )

@@ -30,6 +30,33 @@ def _pubkey(get_pubkey, state, index: int) -> bls.PublicKey:
     return pk
 
 
+def _header_signature_ok(spec: ChainSpec, state, signed_header, pubkey) -> bool:
+    """Proposer signature over a SignedBeaconBlockHeader (the blob-sidecar
+    gossip check, blob_verification.rs verify_header_signature).
+
+    The domain's fork version comes from the SPEC's schedule at the header's
+    slot, not from ``state.fork`` — the head state can lag a fork boundary
+    the header has already crossed."""
+    from ..types.helpers import compute_domain
+
+    hdr = signed_header.message
+    epoch = spec.compute_epoch_at_slot(int(hdr.slot))
+    version = spec.fork_version(spec.fork_name_at_epoch(epoch))
+    domain = compute_domain(
+        spec.DOMAIN_BEACON_PROPOSER,
+        version,
+        bytes(state.genesis_validators_root),
+    )
+    root = compute_signing_root(hdr, domain)
+    try:
+        sig = bls.Signature.from_bytes(bytes(signed_header.signature))
+    except bls.BlsError:
+        return False
+    return bls.verify_signature_sets(
+        [bls.SignatureSet.single_pubkey(sig, pubkey, root)]
+    )
+
+
 def block_proposal_signature_set(
     spec: ChainSpec, state, signed_block, block_root=None, get_pubkey=None
 ) -> bls.SignatureSet:
@@ -113,9 +140,22 @@ def exit_signature_set(
     spec: ChainSpec, state, signed_exit, get_pubkey=None
 ) -> bls.SignatureSet:
     exit_msg = signed_exit.message
-    domain = get_domain(
-        spec, state, spec.DOMAIN_VOLUNTARY_EXIT, epoch=exit_msg.epoch
-    )
+    from ..types.spec import fork_at_least
+
+    if fork_at_least(getattr(state, "fork_name", "phase0"), "deneb"):
+        # deneb pins exit domains to the capella fork version forever
+        # (EIP-7044; ref signature_sets.rs eip7044 handling)
+        from ..types.helpers import compute_domain
+
+        domain = compute_domain(
+            spec.DOMAIN_VOLUNTARY_EXIT,
+            spec.capella_fork_version,
+            bytes(state.genesis_validators_root),
+        )
+    else:
+        domain = get_domain(
+            spec, state, spec.DOMAIN_VOLUNTARY_EXIT, epoch=exit_msg.epoch
+        )
     root = compute_signing_root(exit_msg, domain)
     return bls.SignatureSet.single_pubkey(
         bls.Signature.from_bytes(bytes(signed_exit.signature)),

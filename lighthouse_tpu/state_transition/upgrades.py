@@ -100,10 +100,30 @@ def upgrade_to_capella(spec: ChainSpec, state) -> None:
     invalidate_caches(state)
 
 
+def upgrade_to_deneb(spec: ChainSpec, state) -> None:
+    """capella -> deneb: payload header gains blob-gas fields
+    (upgrade/deneb.rs)."""
+    ns = for_preset(spec.preset.name)
+    epoch = get_current_epoch(spec, state)
+    state.fork = Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=spec.deneb_fork_version,
+        epoch=epoch,
+    )
+    old = state.latest_execution_payload_header
+    new_hdr = ns.ExecutionPayloadHeaderDeneb(
+        **{n: getattr(old, n) for n, _ in type(old).FIELDS}
+    )
+    state.__class__ = ns.BeaconStateDeneb
+    state.latest_execution_payload_header = new_hdr
+    invalidate_caches(state)
+
+
 UPGRADES = {
     "altair": upgrade_to_altair,
     "bellatrix": upgrade_to_bellatrix,
     "capella": upgrade_to_capella,
+    "deneb": upgrade_to_deneb,
 }
 
 _FORK_RANK = {f: i for i, f in enumerate(["phase0", *UPGRADES])}

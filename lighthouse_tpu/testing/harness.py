@@ -231,6 +231,23 @@ class StateHarness:
         sig = self._sign(proposer, compute_signing_root(block, domain))
         return block_cls(message=block, signature=sig)
 
+    def produce_block_with_blobs(self, slot: int, blobs: list, kzg):
+        """Deneb: produce a signed block carrying blob commitments plus its
+        gossip sidecars (the BlockContents production path)."""
+        from ..beacon_chain.data_availability import make_blob_sidecars
+
+        signed = self.produce_block(slot)
+        block = signed.message
+        commitments = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+        block.body.blob_kzg_commitments = commitments
+        signed = self.resign_block(signed)
+        proofs = [
+            kzg.compute_blob_kzg_proof(b, c)
+            for b, c in zip(blobs, commitments)
+        ]
+        sidecars = make_blob_sidecars(self.ns, signed, blobs, proofs)
+        return signed, sidecars
+
     def resign_block(self, signed_block):
         """Recompute state_root + proposer signature after mutating a
         produced block's body (test-only convenience)."""

@@ -437,6 +437,78 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         ]
         fork_name = "capella"
 
+    # -- deneb variants (blobs; consensus/types/src/blob_sidecar.rs) ---------
+
+    class ExecutionPayloadDeneb(Container):
+        FIELDS = ExecutionPayloadCapella.FIELDS + [
+            ("blob_gas_used", uint64),
+            ("excess_blob_gas", uint64),
+        ]
+
+    class ExecutionPayloadHeaderDeneb(Container):
+        FIELDS = ExecutionPayloadHeaderCapella.FIELDS + [
+            ("blob_gas_used", uint64),
+            ("excess_blob_gas", uint64),
+        ]
+
+    class BeaconBlockBodyDeneb(Container):
+        FIELDS = [
+            (n, t) if n != "execution_payload" else (n, ExecutionPayloadDeneb)
+            for n, t in BeaconBlockBodyCapella.FIELDS
+        ] + [
+            (
+                "blob_kzg_commitments",
+                List(KZGCommitment, p.MAX_BLOB_COMMITMENTS_PER_BLOCK),
+            ),
+        ]
+
+    class BeaconBlockDeneb(Container):
+        FIELDS = [
+            ("slot", Slot),
+            ("proposer_index", ValidatorIndex),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BeaconBlockBodyDeneb),
+        ]
+
+    class SignedBeaconBlockDeneb(Container):
+        FIELDS = [("message", BeaconBlockDeneb), ("signature", BLSSignature)]
+
+    class BeaconStateDeneb(Container):
+        FIELDS = [
+            (n, t)
+            if n != "latest_execution_payload_header"
+            else (n, ExecutionPayloadHeaderDeneb)
+            for n, t in BeaconStateCapella.FIELDS
+        ]
+        fork_name = "deneb"
+
+    Blob = ByteVector(32 * p.FIELD_ELEMENTS_PER_BLOB)
+
+    # inclusion-proof depth: commitments-list subtree + length mix-in +
+    # body-fields level (17 on mainnet, 9 on minimal)
+    _commitments_depth = (p.MAX_BLOB_COMMITMENTS_PER_BLOCK - 1).bit_length()
+    _body_depth = (len(BeaconBlockBodyDeneb.FIELDS) - 1).bit_length()
+    KZG_COMMITMENT_INCLUSION_PROOF_DEPTH = _commitments_depth + 1 + _body_depth
+
+    class BlobSidecar(Container):
+        """Gossiped blob container (consensus/types/src/blob_sidecar.rs)."""
+
+        FIELDS = [
+            ("index", uint64),
+            ("blob", Blob),
+            ("kzg_commitment", KZGCommitment),
+            ("kzg_proof", ByteVector(48)),
+            ("signed_block_header", SignedBeaconBlockHeader),
+            (
+                "kzg_commitment_inclusion_proof",
+                Vector(Root, KZG_COMMITMENT_INCLUSION_PROOF_DEPTH),
+            ),
+        ]
+
+    class BlobIdentifier(Container):
+        FIELDS = [("block_root", Root), ("index", uint64)]
+
     ns = SimpleNamespace(
         preset=p,
         IndexedAttestation=IndexedAttestation,
@@ -468,32 +540,47 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         SignedBeaconBlockCapella=SignedBeaconBlockCapella,
         BeaconStateBellatrix=BeaconStateBellatrix,
         BeaconStateCapella=BeaconStateCapella,
+        ExecutionPayloadDeneb=ExecutionPayloadDeneb,
+        ExecutionPayloadHeaderDeneb=ExecutionPayloadHeaderDeneb,
+        BeaconBlockBodyDeneb=BeaconBlockBodyDeneb,
+        BeaconBlockDeneb=BeaconBlockDeneb,
+        SignedBeaconBlockDeneb=SignedBeaconBlockDeneb,
+        BeaconStateDeneb=BeaconStateDeneb,
+        Blob=Blob,
+        BlobSidecar=BlobSidecar,
+        BlobIdentifier=BlobIdentifier,
+        KZG_COMMITMENT_INCLUSION_PROOF_DEPTH=KZG_COMMITMENT_INCLUSION_PROOF_DEPTH,
         # fork-indexed lookup used by generic code
         state_types={
             "phase0": BeaconState,
             "altair": BeaconStateAltair,
             "bellatrix": BeaconStateBellatrix,
             "capella": BeaconStateCapella,
+            "deneb": BeaconStateDeneb,
         },
         block_types={
             "phase0": SignedBeaconBlock,
             "altair": SignedBeaconBlockAltair,
             "bellatrix": SignedBeaconBlockBellatrix,
             "capella": SignedBeaconBlockCapella,
+            "deneb": SignedBeaconBlockDeneb,
         },
         body_types={
             "phase0": BeaconBlockBody,
             "altair": BeaconBlockBodyAltair,
             "bellatrix": BeaconBlockBodyBellatrix,
             "capella": BeaconBlockBodyCapella,
+            "deneb": BeaconBlockBodyDeneb,
         },
         payload_types={
             "bellatrix": ExecutionPayloadBellatrix,
             "capella": ExecutionPayloadCapella,
+            "deneb": ExecutionPayloadDeneb,
         },
         payload_header_types={
             "bellatrix": ExecutionPayloadHeaderBellatrix,
             "capella": ExecutionPayloadHeaderCapella,
+            "deneb": ExecutionPayloadHeaderDeneb,
         },
     )
     return ns

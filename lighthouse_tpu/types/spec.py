@@ -68,6 +68,7 @@ class Preset:
     # deneb
     MAX_BLOB_COMMITMENTS_PER_BLOCK: int
     FIELD_ELEMENTS_PER_BLOB: int
+    MAX_BLOBS_PER_BLOCK: int
     # electra
     MAX_ATTESTER_SLASHINGS_ELECTRA: int
     MAX_ATTESTATIONS_ELECTRA: int
@@ -114,6 +115,7 @@ MAINNET = Preset(
     MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=16384,
     MAX_BLOB_COMMITMENTS_PER_BLOCK=4096,
     FIELD_ELEMENTS_PER_BLOB=4096,
+    MAX_BLOBS_PER_BLOCK=6,
     MAX_ATTESTER_SLASHINGS_ELECTRA=1,
     MAX_ATTESTATIONS_ELECTRA=8,
     MAX_DEPOSIT_REQUESTS_PER_PAYLOAD=8192,
@@ -140,6 +142,7 @@ MINIMAL = replace(
     EPOCHS_PER_SYNC_COMMITTEE_PERIOD=8,
     MAX_WITHDRAWALS_PER_PAYLOAD=4,
     MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=16,
+    MAX_BLOB_COMMITMENTS_PER_BLOCK=16,
     FIELD_ELEMENTS_PER_BLOB=4096,
 )
 

@@ -1,0 +1,198 @@
+"""Deneb: blob types, availability gating, sidecar verification, upgrade.
+
+Refs: consensus/types/src/blob_sidecar.rs, beacon_chain/src/
+{blob_verification.rs,data_availability_checker.rs}, upgrade/deneb.rs.
+"""
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import (
+    BeaconChain,
+    BlockPendingAvailability,
+)
+from lighthouse_tpu.beacon_chain.data_availability import (
+    BlobError,
+    commitment_inclusion_proof,
+    verify_commitment_inclusion,
+)
+from lighthouse_tpu.kzg import Kzg
+from lighthouse_tpu.kzg.fr import bls_field_to_bytes
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def kzg():
+    return Kzg()  # ceremony setup
+
+
+def _deneb_spec(**kw):
+    return minimal_spec(
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+        **kw,
+    )
+
+
+def _blob(seed: int) -> bytes:
+    return b"".join(
+        bls_field_to_bytes((seed * 4096 + i) % (2**200)) for i in range(4096)
+    )
+
+
+def test_deneb_genesis_chain_extends():
+    h = StateHarness(_deneb_spec(), 16)
+    assert h.state.fork_name == "deneb"
+    h.extend_chain(4)
+    assert h.state.slot == 4
+    assert int(h.state.latest_execution_payload_header.block_number) == 4
+
+
+def test_upgrade_capella_to_deneb():
+    spec = minimal_spec(
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=1,
+    )
+    h = StateHarness(spec, 16)
+    assert h.state.fork_name == "capella"
+    h.extend_chain(spec.preset.SLOTS_PER_EPOCH)
+    assert h.state.fork_name == "deneb"
+    assert hasattr(h.state.latest_execution_payload_header, "excess_blob_gas")
+    h.extend_chain(2)  # keeps producing after the upgrade
+
+
+def test_inclusion_proof_roundtrip(kzg):
+    h = StateHarness(_deneb_spec(), 16)
+    blobs = [_blob(1)]
+    signed, sidecars = h.produce_block_with_blobs(1, blobs, kzg)
+    assert len(sidecars) == 1
+    assert verify_commitment_inclusion(h.ns, sidecars[0])
+    # tamper with the commitment: proof must fail
+    bad = h.ns.BlobSidecar.decode(h.ns.BlobSidecar.encode(sidecars[0]))
+    bad.kzg_commitment = b"\xc0" + b"\x00" * 47
+    assert not verify_commitment_inclusion(h.ns, bad)
+
+
+def test_availability_gating_and_import(kzg):
+    spec = _deneb_spec()
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock, kzg=kzg)
+    blobs = [_blob(2), _blob(3)]
+    signed, sidecars = h.produce_block_with_blobs(1, blobs, kzg)
+    clock.set_slot(1)
+    # block first: parked until blobs arrive
+    with pytest.raises(BlockPendingAvailability):
+        chain.process_block(signed)
+    assert chain.process_gossip_blob(sidecars[0]) is None
+    root = chain.process_gossip_blob(sidecars[1])
+    assert root is not None
+    assert chain.head.root == root
+    h.apply_block(signed)
+
+
+def test_blocks_without_blobs_import_directly(kzg):
+    spec = _deneb_spec()
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock, kzg=kzg)
+    signed = h.produce_block(1)
+    clock.set_slot(1)
+    root = chain.process_block(signed)
+    assert chain.head.root == root
+
+
+def test_bad_sidecars_rejected(kzg):
+    spec = _deneb_spec()
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock, kzg=kzg)
+    blobs = [_blob(4)]
+    signed, sidecars = h.produce_block_with_blobs(1, blobs, kzg)
+    clock.set_slot(1)
+    sc = sidecars[0]
+    enc = h.ns.BlobSidecar.encode
+
+    out_of_range = h.ns.BlobSidecar.decode(enc(sc))
+    out_of_range.index = spec.preset.MAX_BLOBS_PER_BLOCK
+    with pytest.raises(BlobError):
+        chain.process_gossip_blob(out_of_range)
+
+    wrong_proof = h.ns.BlobSidecar.decode(enc(sc))
+    wrong_proof.kzg_proof = b"\xc0" + b"\x00" * 47
+    with pytest.raises(BlobError):
+        chain.process_gossip_blob(wrong_proof)
+
+    forged_sig = h.ns.BlobSidecar.decode(enc(sc))
+    forged_sig.signed_block_header.signature = b"\xc0" + b"\x00" * 95
+    with pytest.raises(BlobError):
+        chain.process_gossip_blob(forged_sig)
+
+
+def test_chain_segment_requires_blobs(kzg):
+    """Range-sync segments couple blob sidecars with blocks; a commitments-
+    bearing block without its sidecars must not import."""
+    spec = _deneb_spec()
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock, kzg=kzg)
+    signed, sidecars = h.produce_block_with_blobs(1, [_blob(9)], kzg)
+    h.apply_block(signed)
+    clock.set_slot(1)
+    root = type(signed.message).hash_tree_root(signed.message)
+    with pytest.raises(BlockPendingAvailability):
+        chain.process_chain_segment([signed])
+    assert chain.process_chain_segment([signed], blobs_by_root={root: sidecars}) == [
+        root
+    ]
+    assert chain.head.root == root
+
+
+def test_too_many_commitments_rejected(kzg):
+    """MAX_BLOBS_PER_BLOCK is a state-transition bound, not just gossip."""
+    from lighthouse_tpu.state_transition.per_block import BlockProcessingError
+
+    spec = _deneb_spec()
+    h = StateHarness(spec, 16)
+    signed = h.produce_block(1)
+    signed.message.body.blob_kzg_commitments = [
+        b"\xc0" + b"\x00" * 47
+    ] * (spec.preset.MAX_BLOBS_PER_BLOCK + 1)
+    # the state transition itself rejects the block (resigning replays it)
+    with pytest.raises(BlockProcessingError):
+        h.resign_block(signed)
+        h.apply_block(signed)
+
+
+def test_deneb_exit_uses_capella_domain():
+    """EIP-7044: deneb exits sign over the capella fork domain."""
+    from lighthouse_tpu.state_transition.signature_sets import exit_signature_set
+    from lighthouse_tpu.types.containers import SignedVoluntaryExit, VoluntaryExit
+    from lighthouse_tpu.types.helpers import compute_domain, compute_signing_root
+
+    spec = _deneb_spec()
+    h = StateHarness(spec, 16)
+    exit_msg = VoluntaryExit(epoch=0, validator_index=3)
+    domain = compute_domain(
+        spec.DOMAIN_VOLUNTARY_EXIT,
+        spec.capella_fork_version,
+        bytes(h.state.genesis_validators_root),
+    )
+    sig = h._sign(3, compute_signing_root(exit_msg, domain))
+    signed = SignedVoluntaryExit(message=exit_msg, signature=sig)
+    s = exit_signature_set(spec, h.state, signed)
+    assert bls.verify_signature_sets([s])
