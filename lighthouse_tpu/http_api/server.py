@@ -265,9 +265,14 @@ class BeaconApiServer:
         try:
             self.chain.process_block(signed)
         except BlockPendingAvailability:
+            from ..beacon_chain.data_availability import BlobError
+
             imported = None
-            for sc in sidecars:
-                imported = self.chain.process_gossip_blob(sc)
+            try:
+                for sc in sidecars:
+                    imported = self.chain.process_gossip_blob(sc)
+            except (BlobError, BlockError) as e:
+                raise ApiError(400, str(e)) from None
             if imported is None:
                 raise ApiError(
                     400, "block pending blob availability"

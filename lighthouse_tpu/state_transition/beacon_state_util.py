@@ -213,6 +213,16 @@ def get_indexed_attestation(spec: ChainSpec, state, attestation):
     from ..types.containers import for_preset
 
     ns = for_preset(spec.preset.name)
+    if hasattr(attestation, "committee_bits"):
+        from .electra import get_attesting_indices_electra
+
+        return ns.IndexedAttestationElectra(
+            attesting_indices=sorted(
+                get_attesting_indices_electra(spec, state, attestation)
+            ),
+            data=attestation.data,
+            signature=attestation.signature,
+        )
     indices = get_attesting_indices(
         spec, state, attestation.data, attestation.aggregation_bits
     )

@@ -53,6 +53,12 @@ def compute_activation_exit_epoch(spec: ChainSpec, epoch: int) -> int:
 
 
 def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
+    from ..types.spec import fork_at_least
+
+    if fork_at_least(getattr(state, "fork_name", "phase0"), "electra"):
+        from .electra import initiate_validator_exit_electra
+
+        return initiate_validator_exit_electra(spec, state, index)
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
@@ -84,11 +90,15 @@ def slash_validator(
     state.slashings[epoch % spec.preset.EPOCHS_PER_SLASHINGS_VECTOR] += (
         v.effective_balance
     )
+    from ..types.spec import fork_at_least
+
     fork = getattr(state, "fork_name", "phase0")
     if fork == "phase0":
         slash_quotient = spec.min_slashing_penalty_quotient
     elif fork == "altair":
         slash_quotient = spec.min_slashing_penalty_quotient_altair
+    elif fork_at_least(fork, "electra"):
+        slash_quotient = spec.min_slashing_penalty_quotient_electra
     else:
         slash_quotient = spec.min_slashing_penalty_quotient_bellatrix
     decrease_balance(state, slashed_index, v.effective_balance // slash_quotient)
@@ -96,9 +106,12 @@ def slash_validator(
     proposer_index = get_beacon_proposer_index(spec, state)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
-    whistleblower_reward = (
-        v.effective_balance // spec.whistleblower_reward_quotient
+    wb_quotient = (
+        spec.whistleblower_reward_quotient_electra
+        if fork_at_least(fork, "electra")
+        else spec.whistleblower_reward_quotient
     )
+    whistleblower_reward = v.effective_balance // wb_quotient
     proposer_reward = whistleblower_reward // spec.proposer_reward_quotient
     if fork != "phase0":
         # altair+: proposer gets PROPOSER_WEIGHT/WEIGHT_DENOMINATOR of the reward

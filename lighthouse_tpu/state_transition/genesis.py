@@ -111,6 +111,19 @@ def interop_genesis_state(
             timestamp=genesis_time,
             prev_randao=ETH1_BLOCK_HASH,
         )
+    if fork_at_least(fork_name, "electra"):
+        from .common import compute_activation_exit_epoch
+        from .electra import UNSET_DEPOSIT_REQUESTS_START_INDEX
+
+        state.deposit_requests_start_index = UNSET_DEPOSIT_REQUESTS_START_INDEX
+        state.earliest_exit_epoch = compute_activation_exit_epoch(
+            spec, GENESIS_EPOCH
+        )
+        state.earliest_consolidation_epoch = compute_activation_exit_epoch(
+            spec, GENESIS_EPOCH
+        )
+        # interop validators carry 32 ETH with 0x00 credentials: effective
+        # balance ceiling is min_activation_balance, already satisfied
     return state
 
 

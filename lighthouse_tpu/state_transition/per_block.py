@@ -360,10 +360,24 @@ def process_eth1_data(spec, state, body):
 
 
 def process_operations(spec, state, body, ctxt: ConsensusContext, verify: bool):
-    expected_deposits = min(
-        spec.preset.MAX_DEPOSITS,
-        state.eth1_data.deposit_count - state.eth1_deposit_index,
-    )
+    electra = fork_at_least(getattr(state, "fork_name", "phase0"), "electra")
+    if electra:
+        # EIP-6110: legacy eth1 deposits stop at deposit_requests_start_index
+        limit = min(
+            int(state.eth1_data.deposit_count),
+            int(state.deposit_requests_start_index),
+        )
+        if int(state.eth1_deposit_index) < limit:
+            expected_deposits = min(
+                spec.preset.MAX_DEPOSITS, limit - int(state.eth1_deposit_index)
+            )
+        else:
+            expected_deposits = 0
+    else:
+        expected_deposits = min(
+            spec.preset.MAX_DEPOSITS,
+            state.eth1_data.deposit_count - state.eth1_deposit_index,
+        )
     if len(body.deposits) != expected_deposits:
         raise BlockProcessingError(
             f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
@@ -380,6 +394,20 @@ def process_operations(spec, state, body, ctxt: ConsensusContext, verify: bool):
         process_exit(spec, state, ex, verify)
     for change in getattr(body, "bls_to_execution_changes", []):
         process_bls_to_execution_change(spec, state, change, verify)
+    requests = getattr(body, "execution_requests", None)
+    if requests is not None:
+        from .electra import (
+            process_consolidation_request,
+            process_deposit_request,
+            process_withdrawal_request,
+        )
+
+        for dr in requests.deposits:
+            process_deposit_request(spec, state, dr)
+        for wr in requests.withdrawals:
+            process_withdrawal_request(spec, state, wr, ctxt)
+        for cr in requests.consolidations:
+            process_consolidation_request(spec, state, cr, ctxt)
 
 
 # -- execution payloads (bellatrix+) ---------------------------------------------
@@ -462,15 +490,33 @@ def has_eth1_withdrawal_credential(validator) -> bool:
     return bytes(validator.withdrawal_credentials)[:1] == b"\x01"
 
 
-def is_fully_withdrawable_validator(validator, balance: int, epoch: int) -> bool:
-    return (
-        has_eth1_withdrawal_credential(validator)
-        and validator.withdrawable_epoch <= epoch
-        and balance > 0
-    )
+def is_fully_withdrawable_validator(
+    validator, balance: int, epoch: int, electra: bool = False
+) -> bool:
+    if electra:
+        from .electra import has_execution_withdrawal_credential
+
+        cred_ok = has_execution_withdrawal_credential(validator)
+    else:
+        cred_ok = has_eth1_withdrawal_credential(validator)
+    return cred_ok and validator.withdrawable_epoch <= epoch and balance > 0
 
 
-def is_partially_withdrawable_validator(spec, validator, balance: int) -> bool:
+def is_partially_withdrawable_validator(
+    spec, validator, balance: int, electra: bool = False
+) -> bool:
+    if electra:
+        from .electra import (
+            get_max_effective_balance,
+            has_execution_withdrawal_credential,
+        )
+
+        max_eb = get_max_effective_balance(spec, validator)
+        return (
+            has_execution_withdrawal_credential(validator)
+            and int(validator.effective_balance) == max_eb
+            and balance > max_eb
+        )
     return (
         has_eth1_withdrawal_credential(validator)
         and validator.effective_balance == spec.max_effective_balance
@@ -478,21 +524,72 @@ def is_partially_withdrawable_validator(spec, validator, balance: int) -> bool:
     )
 
 
-def get_expected_withdrawals(spec, state) -> list:
-    """Capella withdrawal sweep (get_expected_withdrawals)."""
+def get_expected_withdrawals(spec, state):
+    """Withdrawal sweep. Capella: full/partial sweep only. Electra adds the
+    pending-partial-withdrawal queue ahead of the sweep (EIP-7251) and
+    credential-dependent effective-balance ceilings.
+
+    Always returns ``(withdrawals, processed_partials)`` — the second
+    element is 0 before electra.
+    """
     from ..types.containers import Withdrawal
     from .beacon_state_util import get_current_epoch
 
+    electra = fork_at_least(getattr(state, "fork_name", "phase0"), "electra")
     epoch = get_current_epoch(spec, state)
     widx = int(state.next_withdrawal_index)
     vidx = int(state.next_withdrawal_validator_index)
     n = len(state.validators)
     out = []
+    processed_partials = 0
+
+    if electra:
+        from .electra import has_execution_withdrawal_credential
+
+        for w in state.pending_partial_withdrawals:
+            if (
+                int(w.withdrawable_epoch) > epoch
+                or len(out)
+                == spec.preset.MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP
+            ):
+                break
+            i = int(w.validator_index)
+            v = state.validators[i]
+            ok = (
+                v.exit_epoch == FAR_FUTURE_EPOCH
+                and int(v.effective_balance) >= spec.min_activation_balance
+                and int(state.balances[i]) > spec.min_activation_balance
+            )
+            if ok:
+                amount = min(
+                    int(state.balances[i]) - spec.min_activation_balance,
+                    int(w.amount),
+                )
+                out.append(
+                    Withdrawal(
+                        index=widx, validator_index=i,
+                        address=bytes(v.withdrawal_credentials)[12:],
+                        amount=amount,
+                    )
+                )
+                widx += 1
+            processed_partials += 1
+
     for _ in range(min(n, spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
         v = state.validators[vidx]
-        balance = int(state.balances[vidx])
+        # balances already claimed by the partial stage don't double-count
+        already = sum(
+            int(w.amount) for w in out if int(w.validator_index) == vidx
+        )
+        balance = int(state.balances[vidx]) - already
         address = bytes(v.withdrawal_credentials)[12:]
-        if is_fully_withdrawable_validator(v, balance, epoch):
+        if electra:
+            from .electra import get_max_effective_balance
+
+            max_eb = get_max_effective_balance(spec, v)
+        else:
+            max_eb = spec.max_effective_balance
+        if is_fully_withdrawable_validator(v, balance, epoch, electra=electra):
             out.append(
                 Withdrawal(
                     index=widx, validator_index=vidx, address=address,
@@ -500,24 +597,34 @@ def get_expected_withdrawals(spec, state) -> list:
                 )
             )
             widx += 1
-        elif is_partially_withdrawable_validator(spec, v, balance):
+        elif is_partially_withdrawable_validator(
+            spec, v, balance, electra=electra
+        ):
             out.append(
                 Withdrawal(
                     index=widx, validator_index=vidx, address=address,
-                    amount=balance - spec.max_effective_balance,
+                    amount=balance - max_eb,
                 )
             )
             widx += 1
         if len(out) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
             break
         vidx = (vidx + 1) % n
-    return out
+    return out, processed_partials
+
+
+def _expected_withdrawals_list(spec, state) -> list:
+    return get_expected_withdrawals(spec, state)[0]
 
 
 def process_withdrawals(spec, state, payload) -> None:
     from .common import decrease_balance
 
-    expected = get_expected_withdrawals(spec, state)
+    expected, processed_partials = get_expected_withdrawals(spec, state)
+    if processed_partials:
+        state.pending_partial_withdrawals = list(
+            state.pending_partial_withdrawals
+        )[processed_partials:]
     got = list(payload.withdrawals)
     if len(got) != len(expected) or any(
         type(a).encode(a) != type(b).encode(b) for a, b in zip(got, expected)
@@ -623,23 +730,49 @@ def _validate_attestation_common(spec, state, data):
         raise BlockProcessingError("attestation target epoch out of range")
     if data.target.epoch != spec.compute_epoch_at_slot(data.slot):
         raise BlockProcessingError("attestation target/slot mismatch")
-    if not (
-        data.slot + spec.min_attestation_inclusion_delay
-        <= state.slot
-        <= data.slot + spec.preset.SLOTS_PER_EPOCH
-    ):
+    if data.slot + spec.min_attestation_inclusion_delay > state.slot:
         raise BlockProcessingError("attestation outside inclusion window")
+    # EIP-7045 (deneb) removed the one-epoch inclusion upper bound; the
+    # target-epoch range check above is the only recency constraint since
+    if not fork_at_least(getattr(state, "fork_name", "phase0"), "deneb"):
+        if state.slot > data.slot + spec.preset.SLOTS_PER_EPOCH:
+            raise BlockProcessingError("attestation outside inclusion window")
     if data.index >= get_committee_count_per_slot(spec, state, data.target.epoch):
+        # electra attestations carry index 0 and pass trivially; the real
+        # committee bound is checked against committee_bits by the caller
         raise BlockProcessingError("committee index out of range")
 
 
 def process_attestation(spec, state, attestation, att_index, ctxt, verify: bool):
     data = attestation.data
     _validate_attestation_common(spec, state, data)
-    committee = get_beacon_committee(spec, state, data.slot, data.index)
-    bits = np.asarray(attestation.aggregation_bits, dtype=bool)
-    if bits.size != committee.size:
-        raise BlockProcessingError("aggregation bits != committee size")
+    if hasattr(attestation, "committee_bits"):
+        # EIP-7549: data.index must be zero; committee structure rides in
+        # committee_bits, aggregation bits span the slot's committees
+        from .electra import get_committee_indices
+
+        if int(data.index) != 0:
+            raise BlockProcessingError("electra attestation: nonzero data.index")
+        committee_indices = get_committee_indices(attestation.committee_bits)
+        per_slot = get_committee_count_per_slot(spec, state, data.target.epoch)
+        if not committee_indices:
+            raise BlockProcessingError("electra attestation: no committee bits")
+        if any(ci >= per_slot for ci in committee_indices):
+            raise BlockProcessingError("electra attestation: committee oob")
+        bits = np.asarray(attestation.aggregation_bits, dtype=bool)
+        total = sum(
+            get_beacon_committee(spec, state, data.slot, ci).size
+            for ci in committee_indices
+        )
+        if bits.size != total:
+            raise BlockProcessingError(
+                "electra attestation: aggregation bits != committee sizes"
+            )
+    else:
+        committee = get_beacon_committee(spec, state, data.slot, data.index)
+        bits = np.asarray(attestation.aggregation_bits, dtype=bool)
+        if bits.size != committee.size:
+            raise BlockProcessingError("aggregation bits != committee size")
 
     indexed = ctxt.indexed_attestations.get(att_index)
     if indexed is None:
@@ -788,6 +921,26 @@ def process_deposit(spec, state, deposit, ctxt: ConsensusContext | None = None):
 def apply_deposit(spec, state, data, check_signature: bool = True, ctxt=None):
     pk = bytes(data.pubkey)
     index = (ctxt or ConsensusContext()).lookup_pubkey_index(state, pk)
+    if fork_at_least(getattr(state, "fork_name", "phase0"), "electra"):
+        # EIP-7251: every deposit flows through the pending queue; new keys
+        # join the registry immediately with zero balance
+        from ..types.containers import for_preset
+
+        ns = for_preset(spec.preset.name)
+        if index is None:
+            if check_signature and not sigs.deposit_signature_is_valid(spec, data):
+                return
+            add_validator_to_registry(spec, state, data, amount_override=0)
+        state.pending_deposits = list(state.pending_deposits) + [
+            ns.PendingDeposit(
+                pubkey=pk,
+                withdrawal_credentials=bytes(data.withdrawal_credentials),
+                amount=int(data.amount),
+                signature=bytes(data.signature),
+                slot=0,  # GENESIS_SLOT: eth1-bridge deposits are pre-finalized
+            )
+        ]
+        return
     if index is None:
         if check_signature and not sigs.deposit_signature_is_valid(spec, data):
             return  # invalid deposit signature: skipped, not fatal
@@ -796,13 +949,22 @@ def apply_deposit(spec, state, data, check_signature: bool = True, ctxt=None):
         increase_balance(state, index, data.amount)
 
 
-def add_validator_to_registry(spec, state, data):
+def add_validator_to_registry(spec, state, data, amount_override=None):
     from ..types.containers import Validator
 
-    amount = data.amount
+    amount = int(data.amount) if amount_override is None else amount_override
+    if fork_at_least(getattr(state, "fork_name", "phase0"), "electra"):
+        from .electra import COMPOUNDING_WITHDRAWAL_PREFIX
+
+        max_eff = (
+            spec.max_effective_balance_electra
+            if bytes(data.withdrawal_credentials)[:1] == COMPOUNDING_WITHDRAWAL_PREFIX
+            else spec.min_activation_balance
+        )
+    else:
+        max_eff = spec.max_effective_balance
     effective = min(
-        amount - amount % spec.effective_balance_increment,
-        spec.max_effective_balance,
+        amount - amount % spec.effective_balance_increment, max_eff
     )
     state.validators = list(state.validators) + [
         Validator(
@@ -843,6 +1005,11 @@ def process_exit(spec, state, signed_exit, verify: bool):
         raise BlockProcessingError("exit: not yet valid")
     if cur < v.activation_epoch + spec.shard_committee_period:
         raise BlockProcessingError("exit: too young")
+    if fork_at_least(getattr(state, "fork_name", "phase0"), "electra"):
+        from .electra import get_pending_balance_to_withdraw
+
+        if get_pending_balance_to_withdraw(state, int(exit_msg.validator_index)):
+            raise BlockProcessingError("exit: pending partial withdrawals")
     if verify:
         s = sigs.exit_signature_set(spec, state, signed_exit)
         if not bls.verify_signature_sets([s]):

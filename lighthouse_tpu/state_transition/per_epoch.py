@@ -280,13 +280,18 @@ def process_rewards_and_penalties_phase0(spec, state, cols: _Cols):
 
 
 def process_registry_updates(spec, state, cols: _Cols):
+    from ..types.spec import fork_at_least
+
+    electra = fork_at_least(getattr(state, "fork_name", "phase0"), "electra")
     cur = get_current_epoch(spec, state)
-    # eligibility
+    # eligibility: electra keys on MIN_ACTIVATION_BALANCE (EIP-7251)
     for i, v in enumerate(state.validators):
-        if (
-            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
-            and v.effective_balance == spec.max_effective_balance
-        ):
+        eligible = (
+            v.effective_balance >= spec.min_activation_balance
+            if electra
+            else v.effective_balance == spec.max_effective_balance
+        )
+        if v.activation_eligibility_epoch == FAR_FUTURE_EPOCH and eligible:
             v.activation_eligibility_epoch = cur + 1
         if (
             (cols.activation[i] <= np.uint64(cur) < cols.exit[i])
@@ -307,7 +312,10 @@ def process_registry_updates(spec, state, cols: _Cols):
     )
     from .common import get_validator_activation_churn_limit
 
-    for i in queue[: get_validator_activation_churn_limit(spec, state)]:
+    # electra: activations are throttled by the pending-deposit balance
+    # churn instead of a head-count limit here (EIP-7251)
+    limit = None if electra else get_validator_activation_churn_limit(spec, state)
+    for i in queue[:limit]:
         state.validators[i].activation_epoch = compute_activation_exit_epoch(
             spec, cur
         )
@@ -328,10 +336,17 @@ def process_slashings(spec, state, cols: _Cols):
     if not hit.any():
         return
     increment = spec.effective_balance_increment
-    penalty_numer = (
-        cols.effective[hit] // np.uint64(increment) * np.uint64(adjusted)
-    )
-    penalty = penalty_numer // np.uint64(total) * np.uint64(increment)
+    from ..types.spec import fork_at_least
+
+    if fork_at_least(fork, "electra"):
+        # EIP-7251 overflow-safe form: per-increment penalty first
+        per_increment = np.uint64(adjusted // (total // increment))
+        penalty = cols.effective[hit] // np.uint64(increment) * per_increment
+    else:
+        penalty_numer = (
+            cols.effective[hit] // np.uint64(increment) * np.uint64(adjusted)
+        )
+        penalty = penalty_numer // np.uint64(total) * np.uint64(increment)
     bal = balances_array(state)
     idx = np.nonzero(hit)[0]
     dec = np.minimum(penalty, bal[idx])
@@ -352,13 +367,22 @@ def process_effective_balance_updates(spec, state):
     hysteresis = increment // HYSTERESIS_QUOTIENT
     down = hysteresis * HYSTERESIS_DOWNWARD_MULTIPLIER
     up = hysteresis * HYSTERESIS_UPWARD_MULTIPLIER
+    from ..types.spec import fork_at_least
+
+    electra = fork_at_least(getattr(state, "fork_name", "phase0"), "electra")
+    if electra:
+        from .electra import get_max_effective_balance
+
     bal = balances_array(state)
     for i, v in enumerate(state.validators):
         b = int(bal[i])
         if b + down < v.effective_balance or v.effective_balance + up < b:
-            v.effective_balance = min(
-                b - b % increment, spec.max_effective_balance
+            limit = (
+                get_max_effective_balance(spec, v)
+                if electra
+                else spec.max_effective_balance
             )
+            v.effective_balance = min(b - b % increment, limit)
 
 
 def process_slashings_reset(spec, state):
@@ -424,6 +448,16 @@ def _process_epoch_altair(spec: ChainSpec, state) -> None:
     process_registry_updates(spec, state, cols)
     process_slashings(spec, state, cols)
     process_eth1_data_reset(spec, state)
+    from ..types.spec import fork_at_least
+
+    if fork_at_least(getattr(state, "fork_name", "altair"), "electra"):
+        from .electra import (
+            process_pending_consolidations,
+            process_pending_deposits,
+        )
+
+        process_pending_deposits(spec, state)
+        process_pending_consolidations(spec, state)
     process_effective_balance_updates(spec, state)
     process_slashings_reset(spec, state)
     process_randao_mixes_reset(spec, state)

@@ -119,11 +119,88 @@ def upgrade_to_deneb(spec: ChainSpec, state) -> None:
     invalidate_caches(state)
 
 
+def upgrade_to_electra(spec: ChainSpec, state) -> None:
+    """deneb -> electra (upgrade/electra.rs): balance-churn bookkeeping,
+    pre-activation validators re-queued as pending deposits, compounding
+    early adopters get their excess balance queued."""
+    from .common import FAR_FUTURE_EPOCH, compute_activation_exit_epoch
+    from .electra import (
+        G2_POINT_AT_INFINITY,
+        UNSET_DEPOSIT_REQUESTS_START_INDEX,
+        get_activation_exit_churn_limit,
+        get_consolidation_churn_limit,
+        has_compounding_withdrawal_credential,
+        queue_excess_active_balance,
+    )
+
+    ns = for_preset(spec.preset.name)
+    epoch = get_current_epoch(spec, state)
+    state.fork = Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=spec.electra_fork_version,
+        epoch=epoch,
+    )
+    earliest_exit = compute_activation_exit_epoch(spec, epoch)
+    for v in state.validators:
+        if v.exit_epoch != FAR_FUTURE_EPOCH:
+            earliest_exit = max(earliest_exit, int(v.exit_epoch))
+    earliest_exit += 1
+
+    state.__class__ = ns.BeaconStateElectra
+    state.deposit_requests_start_index = UNSET_DEPOSIT_REQUESTS_START_INDEX
+    state.deposit_balance_to_consume = 0
+    state.exit_balance_to_consume = 0
+    state.earliest_exit_epoch = earliest_exit
+    state.consolidation_balance_to_consume = 0
+    state.earliest_consolidation_epoch = compute_activation_exit_epoch(spec, epoch)
+    state.pending_deposits = []
+    state.pending_partial_withdrawals = []
+    state.pending_consolidations = []
+    invalidate_caches(state)
+    state.exit_balance_to_consume = get_activation_exit_churn_limit(spec, state)
+    state.consolidation_balance_to_consume = get_consolidation_churn_limit(
+        spec, state
+    )
+
+    # re-queue validators that had not activated as pending deposits
+    pre_activation = sorted(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if v.activation_epoch == FAR_FUTURE_EPOCH
+        ),
+        key=lambda i: (
+            int(state.validators[i].activation_eligibility_epoch),
+            i,
+        ),
+    )
+    for i in pre_activation:
+        v = state.validators[i]
+        balance = int(state.balances[i])
+        state.balances[i] = 0
+        v.effective_balance = 0
+        v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+        state.pending_deposits = list(state.pending_deposits) + [
+            ns.PendingDeposit(
+                pubkey=bytes(v.pubkey),
+                withdrawal_credentials=bytes(v.withdrawal_credentials),
+                amount=balance,
+                signature=G2_POINT_AT_INFINITY,
+                slot=0,
+            )
+        ]
+    # early compounding adopters keep their excess working
+    for i, v in enumerate(state.validators):
+        if has_compounding_withdrawal_credential(v):
+            queue_excess_active_balance(spec, state, i)
+
+
 UPGRADES = {
     "altair": upgrade_to_altair,
     "bellatrix": upgrade_to_bellatrix,
     "capella": upgrade_to_capella,
     "deneb": upgrade_to_deneb,
+    "electra": upgrade_to_electra,
 }
 
 _FORK_RANK = {f: i for i, f in enumerate(["phase0", *UPGRADES])}

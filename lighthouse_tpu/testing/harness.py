@@ -88,11 +88,14 @@ class StateHarness:
         n_comm = get_committee_count_per_slot(spec, state, epoch)
         from ..types.containers import AttestationData
 
+        electra = fork_at_least(
+            spec.fork_name_at_epoch(epoch), "electra"
+        )
         for index in range(n_comm):
             committee = get_beacon_committee(spec, state, slot, index)
             data = AttestationData(
                 slot=slot,
-                index=index,
+                index=0 if electra else index,
                 beacon_block_root=head_root,
                 source=state.current_justified_checkpoint,
                 target=Checkpoint(epoch=epoch, root=target_root),
@@ -102,13 +105,27 @@ class StateHarness:
             # summed secret key (saves len(committee)-1 native signs)
             agg_sk = sum(self.sks[int(v)] for v in committee) % CURVE_ORDER
             sig = self._nb.sign(agg_sk.to_bytes(32, "big"), root)
-            atts.append(
-                self.ns.Attestation(
-                    aggregation_bits=np.ones(committee.size, dtype=bool),
-                    data=data,
-                    signature=sig,
+            if electra:
+                committee_bits = np.zeros(
+                    spec.preset.MAX_COMMITTEES_PER_SLOT, dtype=bool
                 )
-            )
+                committee_bits[index] = True
+                atts.append(
+                    self.ns.AttestationElectra(
+                        aggregation_bits=np.ones(committee.size, dtype=bool),
+                        data=data,
+                        signature=sig,
+                        committee_bits=committee_bits,
+                    )
+                )
+            else:
+                atts.append(
+                    self.ns.Attestation(
+                        aggregation_bits=np.ones(committee.size, dtype=bool),
+                        data=data,
+                        signature=sig,
+                    )
+                )
         return atts
 
     def unaggregated_attestations_for_slot(
@@ -200,10 +217,16 @@ class StateHarness:
         fork = spec.fork_name_at_epoch(epoch)
         body_cls = self.ns.body_types[fork]
         block_cls = self.ns.block_types[fork]
+        # fork boundary: drop attestations whose container shape predates the
+        # body's list type (EIP-7549 changed the attestation wire format)
+        att_elem = dict(body_cls.FIELDS)["attestations"].elem
+        attestations = [
+            a for a in (attestations or []) if isinstance(a, att_elem)
+        ]
         body = body_cls(
             randao_reveal=self.randao_reveal(state, proposer, epoch),
             eth1_data=state.eth1_data,
-            attestations=attestations or [],
+            attestations=attestations,
         )
         if fork != "phase0":
             body.sync_aggregate = self._sync_aggregate(state, slot)
@@ -277,7 +300,7 @@ class StateHarness:
         from ..state_transition import get_current_epoch, get_randao_mix
         from ..state_transition.per_block import (
             compute_timestamp_at_slot,
-            get_expected_withdrawals,
+            _expected_withdrawals_list,
         )
 
         from ..execution_layer.mock import GENESIS_BLOCK_HASH
@@ -286,7 +309,7 @@ class StateHarness:
         payload_cls = self.ns.payload_types[fork]
         withdrawals = None
         if fork_at_least(fork, "capella"):
-            withdrawals = get_expected_withdrawals(self.spec, state)
+            withdrawals = _expected_withdrawals_list(self.spec, state)
         # pre-merge bellatrix state: this block IS the merge transition —
         # build the first payload on the mock EL's genesis block
         parent_hash = (

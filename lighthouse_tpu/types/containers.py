@@ -509,6 +509,146 @@ def for_preset(preset_name: str) -> SimpleNamespace:
     class BlobIdentifier(Container):
         FIELDS = [("block_root", Root), ("index", uint64)]
 
+    # -- electra variants (EIP-6110/7002/7251/7549) --------------------------
+
+    class DepositRequest(Container):
+        FIELDS = [
+            ("pubkey", BLSPubkey),
+            ("withdrawal_credentials", ByteVector(32)),
+            ("amount", Gwei),
+            ("signature", BLSSignature),
+            ("index", uint64),
+        ]
+
+    class WithdrawalRequest(Container):
+        FIELDS = [
+            ("source_address", Bytes20),
+            ("validator_pubkey", BLSPubkey),
+            ("amount", Gwei),
+        ]
+
+    class ConsolidationRequest(Container):
+        FIELDS = [
+            ("source_address", Bytes20),
+            ("source_pubkey", BLSPubkey),
+            ("target_pubkey", BLSPubkey),
+        ]
+
+    class ExecutionRequests(Container):
+        FIELDS = [
+            ("deposits", List(DepositRequest, p.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD)),
+            ("withdrawals",
+             List(WithdrawalRequest, p.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD)),
+            ("consolidations",
+             List(ConsolidationRequest, p.MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD)),
+        ]
+
+    class PendingDeposit(Container):
+        FIELDS = [
+            ("pubkey", BLSPubkey),
+            ("withdrawal_credentials", ByteVector(32)),
+            ("amount", Gwei),
+            ("signature", BLSSignature),
+            ("slot", Slot),
+        ]
+
+    class PendingPartialWithdrawal(Container):
+        FIELDS = [
+            ("validator_index", ValidatorIndex),
+            ("amount", Gwei),
+            ("withdrawable_epoch", Epoch),
+        ]
+
+    class PendingConsolidation(Container):
+        FIELDS = [("source_index", ValidatorIndex), ("target_index", ValidatorIndex)]
+
+    _electra_agg_limit = p.MAX_VALIDATORS_PER_COMMITTEE * p.MAX_COMMITTEES_PER_SLOT
+
+    class AttestationElectra(Container):
+        """EIP-7549: committee index moves out of AttestationData into
+        committee_bits; aggregation bits span the whole slot."""
+
+        FIELDS = [
+            ("aggregation_bits", Bitlist(_electra_agg_limit)),
+            ("data", AttestationData),
+            ("signature", BLSSignature),
+            ("committee_bits", Bitvector(p.MAX_COMMITTEES_PER_SLOT)),
+        ]
+
+    class IndexedAttestationElectra(Container):
+        FIELDS = [
+            ("attesting_indices", List(uint64, _electra_agg_limit)),
+            ("data", AttestationData),
+            ("signature", BLSSignature),
+        ]
+
+    class AttesterSlashingElectra(Container):
+        FIELDS = [
+            ("attestation_1", IndexedAttestationElectra),
+            ("attestation_2", IndexedAttestationElectra),
+        ]
+
+    class SingleAttestation(Container):
+        """Unaggregated electra gossip attestation."""
+
+        FIELDS = [
+            ("committee_index", CommitteeIndex),
+            ("attester_index", ValidatorIndex),
+            ("data", AttestationData),
+            ("signature", BLSSignature),
+        ]
+
+    class AggregateAndProofElectra(Container):
+        FIELDS = [
+            ("aggregator_index", ValidatorIndex),
+            ("aggregate", AttestationElectra),
+            ("selection_proof", BLSSignature),
+        ]
+
+    class SignedAggregateAndProofElectra(Container):
+        FIELDS = [
+            ("message", AggregateAndProofElectra),
+            ("signature", BLSSignature),
+        ]
+
+    class BeaconBlockBodyElectra(Container):
+        FIELDS = [
+            (n,
+             List(ProposerSlashing, p.MAX_PROPOSER_SLASHINGS) if n == "proposer_slashings"
+             else List(AttesterSlashingElectra, p.MAX_ATTESTER_SLASHINGS_ELECTRA) if n == "attester_slashings"
+             else List(AttestationElectra, p.MAX_ATTESTATIONS_ELECTRA) if n == "attestations"
+             else t)
+            for n, t in BeaconBlockBodyDeneb.FIELDS
+        ] + [("execution_requests", ExecutionRequests)]
+
+    class BeaconBlockElectra(Container):
+        FIELDS = [
+            ("slot", Slot),
+            ("proposer_index", ValidatorIndex),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BeaconBlockBodyElectra),
+        ]
+
+    class SignedBeaconBlockElectra(Container):
+        FIELDS = [("message", BeaconBlockElectra), ("signature", BLSSignature)]
+
+    class BeaconStateElectra(Container):
+        FIELDS = BeaconStateDeneb.FIELDS + [
+            ("deposit_requests_start_index", uint64),
+            ("deposit_balance_to_consume", Gwei),
+            ("exit_balance_to_consume", Gwei),
+            ("earliest_exit_epoch", Epoch),
+            ("consolidation_balance_to_consume", Gwei),
+            ("earliest_consolidation_epoch", Epoch),
+            ("pending_deposits", List(PendingDeposit, p.PENDING_DEPOSITS_LIMIT)),
+            ("pending_partial_withdrawals",
+             List(PendingPartialWithdrawal, p.PENDING_PARTIAL_WITHDRAWALS_LIMIT)),
+            ("pending_consolidations",
+             List(PendingConsolidation, p.PENDING_CONSOLIDATIONS_LIMIT)),
+        ]
+        fork_name = "electra"
+
     ns = SimpleNamespace(
         preset=p,
         IndexedAttestation=IndexedAttestation,
@@ -550,6 +690,23 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         BlobSidecar=BlobSidecar,
         BlobIdentifier=BlobIdentifier,
         KZG_COMMITMENT_INCLUSION_PROOF_DEPTH=KZG_COMMITMENT_INCLUSION_PROOF_DEPTH,
+        DepositRequest=DepositRequest,
+        WithdrawalRequest=WithdrawalRequest,
+        ConsolidationRequest=ConsolidationRequest,
+        ExecutionRequests=ExecutionRequests,
+        PendingDeposit=PendingDeposit,
+        PendingPartialWithdrawal=PendingPartialWithdrawal,
+        PendingConsolidation=PendingConsolidation,
+        AttestationElectra=AttestationElectra,
+        IndexedAttestationElectra=IndexedAttestationElectra,
+        AttesterSlashingElectra=AttesterSlashingElectra,
+        SingleAttestation=SingleAttestation,
+        AggregateAndProofElectra=AggregateAndProofElectra,
+        SignedAggregateAndProofElectra=SignedAggregateAndProofElectra,
+        BeaconBlockBodyElectra=BeaconBlockBodyElectra,
+        BeaconBlockElectra=BeaconBlockElectra,
+        SignedBeaconBlockElectra=SignedBeaconBlockElectra,
+        BeaconStateElectra=BeaconStateElectra,
         # fork-indexed lookup used by generic code
         state_types={
             "phase0": BeaconState,
@@ -557,6 +714,7 @@ def for_preset(preset_name: str) -> SimpleNamespace:
             "bellatrix": BeaconStateBellatrix,
             "capella": BeaconStateCapella,
             "deneb": BeaconStateDeneb,
+            "electra": BeaconStateElectra,
         },
         block_types={
             "phase0": SignedBeaconBlock,
@@ -564,6 +722,7 @@ def for_preset(preset_name: str) -> SimpleNamespace:
             "bellatrix": SignedBeaconBlockBellatrix,
             "capella": SignedBeaconBlockCapella,
             "deneb": SignedBeaconBlockDeneb,
+            "electra": SignedBeaconBlockElectra,
         },
         body_types={
             "phase0": BeaconBlockBody,
@@ -571,16 +730,34 @@ def for_preset(preset_name: str) -> SimpleNamespace:
             "bellatrix": BeaconBlockBodyBellatrix,
             "capella": BeaconBlockBodyCapella,
             "deneb": BeaconBlockBodyDeneb,
+            "electra": BeaconBlockBodyElectra,
         },
         payload_types={
             "bellatrix": ExecutionPayloadBellatrix,
             "capella": ExecutionPayloadCapella,
             "deneb": ExecutionPayloadDeneb,
+            "electra": ExecutionPayloadDeneb,  # payload unchanged in electra
         },
         payload_header_types={
             "bellatrix": ExecutionPayloadHeaderBellatrix,
             "capella": ExecutionPayloadHeaderCapella,
             "deneb": ExecutionPayloadHeaderDeneb,
+            "electra": ExecutionPayloadHeaderDeneb,
+        },
+        attestation_types={
+            "phase0": Attestation, "altair": Attestation,
+            "bellatrix": Attestation, "capella": Attestation,
+            "deneb": Attestation, "electra": AttestationElectra,
+        },
+        indexed_attestation_types={
+            "phase0": IndexedAttestation, "altair": IndexedAttestation,
+            "bellatrix": IndexedAttestation, "capella": IndexedAttestation,
+            "deneb": IndexedAttestation, "electra": IndexedAttestationElectra,
+        },
+        attester_slashing_types={
+            "phase0": AttesterSlashing, "altair": AttesterSlashing,
+            "bellatrix": AttesterSlashing, "capella": AttesterSlashing,
+            "deneb": AttesterSlashing, "electra": AttesterSlashingElectra,
         },
     )
     return ns

@@ -72,6 +72,8 @@ class Preset:
     # electra
     MAX_ATTESTER_SLASHINGS_ELECTRA: int
     MAX_ATTESTATIONS_ELECTRA: int
+    MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP: int
+    MAX_PENDING_DEPOSITS_PER_EPOCH: int
     MAX_DEPOSIT_REQUESTS_PER_PAYLOAD: int
     MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD: int
     MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD: int
@@ -118,6 +120,8 @@ MAINNET = Preset(
     MAX_BLOBS_PER_BLOCK=6,
     MAX_ATTESTER_SLASHINGS_ELECTRA=1,
     MAX_ATTESTATIONS_ELECTRA=8,
+    MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP=8,
+    MAX_PENDING_DEPOSITS_PER_EPOCH=16,
     MAX_DEPOSIT_REQUESTS_PER_PAYLOAD=8192,
     MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD=16,
     MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD=2,
@@ -144,6 +148,7 @@ MINIMAL = replace(
     MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=16,
     MAX_BLOB_COMMITMENTS_PER_BLOCK=16,
     FIELD_ELEMENTS_PER_BLOB=4096,
+    MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP=2,
 )
 
 PRESETS = {"mainnet": MAINNET, "minimal": MINIMAL}

@@ -1,0 +1,240 @@
+"""Electra: balance churn, EL requests, pending queues, EIP-7549 attestations.
+
+Refs: consensus/types/src/eth_spec.rs electra types, state_processing electra
+request handlers + single-pass pending sweeps, upgrade/electra.rs.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.state_transition import electra as el
+from lighthouse_tpu.state_transition import per_epoch
+from lighthouse_tpu.state_transition.common import FAR_FUTURE_EPOCH
+from lighthouse_tpu.state_transition.per_block import BlockProcessingError
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.containers import for_preset
+from lighthouse_tpu.types.spec import minimal_spec
+
+NS = for_preset("minimal")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def _electra_spec(**kw):
+    return minimal_spec(
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+        electra_fork_epoch=0,
+        **kw,
+    )
+
+
+def test_electra_genesis_chain_extends_across_epochs():
+    spec = _electra_spec()
+    h = StateHarness(spec, 16)
+    assert h.state.fork_name == "electra"
+    h.extend_chain(2 * spec.preset.SLOTS_PER_EPOCH + 1)
+    # attestations flowed (EIP-7549 shape) and epochs processed
+    assert int(h.state.finalized_checkpoint.epoch) >= 0
+    assert h.state.slot == 2 * spec.preset.SLOTS_PER_EPOCH + 1
+
+
+def test_upgrade_deneb_to_electra():
+    spec = minimal_spec(
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+        electra_fork_epoch=1,
+    )
+    h = StateHarness(spec, 16)
+    assert h.state.fork_name == "deneb"
+    h.extend_chain(spec.preset.SLOTS_PER_EPOCH + 2)
+    assert h.state.fork_name == "electra"
+    assert int(h.state.deposit_requests_start_index) == el.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    assert int(h.state.earliest_exit_epoch) >= 1
+
+
+def test_deposit_request_flows_through_pending_queue():
+    spec = _electra_spec()
+    h = StateHarness(spec, 16)
+    st = h.state
+    req = NS.DepositRequest(
+        pubkey=bytes(st.validators[3].pubkey),
+        withdrawal_credentials=bytes(st.validators[3].withdrawal_credentials),
+        amount=5 * 10**9,
+        signature=b"\x00" * 96,
+        index=0,
+    )
+    el.process_deposit_request(spec, st, req)
+    assert int(st.deposit_requests_start_index) == 0
+    assert len(st.pending_deposits) == 1
+    # EL-request deposits wait for finality: slot 0 state, request slot 0,
+    # finalized epoch 0 -> processable immediately at next epoch sweep
+    before = int(st.balances[3])
+    el.process_pending_deposits(spec, st)
+    assert int(st.balances[3]) == before + 5 * 10**9
+    assert len(st.pending_deposits) == 0
+
+
+def test_pending_deposit_churn_carryover():
+    spec = _electra_spec()
+    h = StateHarness(spec, 64)
+    st = h.state
+    churn = el.get_activation_exit_churn_limit(spec, st)
+    big = churn + 7 * 10**9
+    st.pending_deposits = [
+        NS.PendingDeposit(
+            pubkey=bytes(st.validators[1].pubkey),
+            withdrawal_credentials=bytes(st.validators[1].withdrawal_credentials),
+            amount=big,
+            signature=el.G2_POINT_AT_INFINITY,
+            slot=0,
+        )
+    ]
+    el.process_pending_deposits(spec, st)
+    # too big for one epoch's churn: postponed, balance accumulates
+    assert len(st.pending_deposits) == 1
+    assert int(st.deposit_balance_to_consume) == churn
+    el.process_pending_deposits(spec, st)
+    assert len(st.pending_deposits) == 0
+
+
+def test_withdrawal_request_full_exit_and_partial():
+    spec = _electra_spec()
+    h = StateHarness(spec, 16)
+    st = h.state
+    # give validator 5 an executable credential owned by address A
+    addr = b"\xaa" * 20
+    v5 = st.validators[5]
+    v5.withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr
+    # full exit needs shard_committee_period elapsed; fake it
+    v5.activation_epoch = 0
+    spec2 = minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+        deneb_fork_epoch=0, electra_fork_epoch=0, shard_committee_period=0,
+    )
+    req = NS.WithdrawalRequest(
+        source_address=addr, validator_pubkey=bytes(v5.pubkey), amount=0
+    )
+    el.process_withdrawal_request(spec2, st, req)
+    assert v5.exit_epoch != FAR_FUTURE_EPOCH  # exit initiated via balance churn
+
+    # partial: compounding validator 6 with excess balance
+    v6 = st.validators[6]
+    v6.withdrawal_credentials = b"\x02" + b"\x00" * 11 + addr
+    st.balances[6] = 40 * 10**9
+    req = NS.WithdrawalRequest(
+        source_address=addr, validator_pubkey=bytes(v6.pubkey), amount=3 * 10**9
+    )
+    el.process_withdrawal_request(spec2, st, req)
+    assert len(st.pending_partial_withdrawals) == 1
+    w = st.pending_partial_withdrawals[0]
+    assert int(w.validator_index) == 6 and int(w.amount) == 3 * 10**9
+    # wrong source address is a silent no-op
+    req_bad = NS.WithdrawalRequest(
+        source_address=b"\xbb" * 20, validator_pubkey=bytes(v6.pubkey), amount=1
+    )
+    el.process_withdrawal_request(spec2, st, req_bad)
+    assert len(st.pending_partial_withdrawals) == 1
+
+
+def test_consolidation_request_and_sweep():
+    spec = minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+        deneb_fork_epoch=0, electra_fork_epoch=0, shard_committee_period=0,
+        # leave churn headroom for consolidations (at tiny stake the spec
+        # formula yields zero consolidation churn, disabling them)
+        max_per_epoch_activation_exit_churn_limit=64 * 10**9,
+    )
+    h = StateHarness(spec, 16)
+    st = h.state
+    addr = b"\xcc" * 20
+    src, tgt = st.validators[7], st.validators[8]
+    src.withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr
+    tgt.withdrawal_credentials = b"\x02" + b"\x00" * 11 + addr
+    req = NS.ConsolidationRequest(
+        source_address=addr,
+        source_pubkey=bytes(src.pubkey),
+        target_pubkey=bytes(tgt.pubkey),
+    )
+    el.process_consolidation_request(spec, st, req)
+    assert len(st.pending_consolidations) == 1
+    assert src.exit_epoch != FAR_FUTURE_EPOCH
+    # sweep once source is withdrawable
+    src.withdrawable_epoch = 0
+    before_t = int(st.balances[8])
+    el.process_pending_consolidations(spec, st)
+    assert len(st.pending_consolidations) == 0
+    assert int(st.balances[8]) == before_t + 32 * 10**9
+    assert int(st.balances[7]) == 0
+
+
+def test_self_consolidation_switches_to_compounding():
+    spec = _electra_spec()
+    h = StateHarness(spec, 16)
+    st = h.state
+    addr = b"\xdd" * 20
+    v = st.validators[9]
+    v.withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr
+    st.balances[9] = 40 * 10**9  # excess above 32 ETH
+    req = NS.ConsolidationRequest(
+        source_address=addr,
+        source_pubkey=bytes(v.pubkey),
+        target_pubkey=bytes(v.pubkey),
+    )
+    el.process_consolidation_request(spec, st, req)
+    assert el.has_compounding_withdrawal_credential(v)
+    # excess queued as a pending deposit, balance clamped to 32 ETH
+    assert int(st.balances[9]) == 32 * 10**9
+    assert len(st.pending_deposits) == 1
+    assert int(st.pending_deposits[0].amount) == 8 * 10**9
+
+
+def test_compounding_effective_balance_ceiling():
+    spec = _electra_spec()
+    h = StateHarness(spec, 16)
+    st = h.state
+    v = st.validators[2]
+    v.withdrawal_credentials = b"\x02" + bytes(v.withdrawal_credentials)[1:]
+    st.balances[2] = 100 * 10**9
+    per_epoch.process_effective_balance_updates(spec, st)
+    assert int(v.effective_balance) == 100 * 10**9  # above the 32 ETH cap
+    # non-compounding neighbour stays capped at min_activation_balance
+    st.balances[3] = 100 * 10**9
+    per_epoch.process_effective_balance_updates(spec, st)
+    assert int(st.validators[3].effective_balance) == 32 * 10**9
+
+
+def test_electra_attestation_multi_committee():
+    """An aggregate spanning two committees via committee_bits."""
+    spec = _electra_spec()
+    h = StateHarness(spec, 16)
+    from lighthouse_tpu.state_transition import get_indexed_attestation
+
+    atts = h.attestations_for_slot(h.state, 0, h.head_root(h.state))
+    assert all(hasattr(a, "committee_bits") for a in atts)
+    indexed = get_indexed_attestation(spec, h.state, atts[0])
+    assert type(indexed).__name__ == "IndexedAttestationElectra"
+    assert len(indexed.attesting_indices) > 0
+
+
+def test_electra_rejects_nonzero_data_index():
+    spec = _electra_spec()
+    h = StateHarness(spec, 16)
+    b1 = h.produce_block(1)
+    h.apply_block(b1)
+    atts = h.attestations_for_slot(h.state, 1, h.head_root(h.state))
+    bad = atts[0]
+    bad.data.index = 1
+    with pytest.raises(BlockProcessingError):
+        h.apply_block(h.produce_block(2, attestations=[bad]))
