@@ -105,6 +105,12 @@ class BeaconChain:
         import threading
 
         self.lock = threading.RLock()
+        from ..store.migrate import BackgroundMigrator
+
+        self.migrator = BackgroundMigrator(self.store)
+        self.store.state_cls_for_slot = lambda slot: self.ns.state_types[
+            spec.fork_name_at_slot(slot)
+        ]
         self._states: dict[bytes, object] = {genesis_root: genesis_state}
         self._blocks: dict[bytes, object] = {}
         self.head = ChainHead(
@@ -119,8 +125,72 @@ class BeaconChain:
 
     def state_by_root(self, block_root: bytes):
         """Post-state of an imported block, or None (public accessor for the
-        API layer; insulates callers from the chain's state-cache layout)."""
-        return self._states.get(block_root)
+        API layer; insulates callers from the chain's state-cache layout).
+        Falls back to the store for states migrated out of memory."""
+        state = self._states.get(block_root)
+        if state is not None:
+            return state
+        if block_root == self.genesis_block_root:
+            return self.genesis_state
+        return self._load_state_from_store(block_root)
+
+    def _load_state_from_store(self, block_root: bytes):
+        """Reload a frozen/persisted state by block root (hot bytes, else
+        the cold hierarchy; replay-layer slots reconstruct the nearest
+        stored anchor and replay stored canonical blocks)."""
+        raw = self.store.get_block(block_root)
+        if raw is None:
+            return None
+        # the block's slot identifies the fork for decoding
+        blk_cls = None
+        for fork in reversed(list(self.ns.block_types)):
+            try:
+                blk_cls = self.ns.block_types[fork]
+                signed = blk_cls.decode(raw)
+                break
+            except Exception:
+                signed = None
+        if signed is None:
+            return None
+        state_root = bytes(signed.message.state_root)
+        from ..store.kv import DBColumn
+
+        ssz = self.store.hot.get(DBColumn.BeaconState, state_root)
+        if ssz is not None:
+            cls = self.ns.state_types[
+                self.spec.fork_name_at_slot(int(signed.message.slot))
+            ]
+            try:
+                return cls.decode(ssz)
+            except Exception:
+                return None
+        # cold path: typed reconstruction directly (no bytes round-trip),
+        # else nearest stored anchor + canonical block replay
+        slot = self.store.cold_slot_for_root(state_root)
+        if slot is None:
+            return None
+        state = self.store.get_cold_state(slot)
+        if state is not None:
+            return state
+        anchor = self.store.replay_anchor(slot)
+        base = self.store.get_cold_state(anchor)
+        if base is None:
+            return None
+        from ..state_transition.block_replayer import BlockReplayer
+
+        blocks = []
+        for s in range(anchor + 1, slot + 1):
+            summary = self.store.cold_summary_at_slot(s)
+            if summary is None:
+                continue
+            raw_b = self.store.get_block(summary[1])
+            if raw_b is None:
+                continue
+            fork = self.spec.fork_name_at_slot(s)
+            blocks.append(self.ns.block_types[fork].decode(raw_b))
+        return (
+            BlockReplayer(self.spec, base).apply_blocks(blocks, slot).state
+        )
 
     # -- block import pipeline -----------------------------------------------------
 
@@ -534,6 +604,7 @@ class BeaconChain:
 
     def _recompute_head_locked(self) -> bytes:
         head_root = self.fork_choice.get_head(self.current_slot())
+        self._maybe_migrate()
         if head_root != self.head.root:
             state = self._states.get(head_root)
             if state is not None:
@@ -541,6 +612,13 @@ class BeaconChain:
                     root=head_root, slot=state.slot, state=state
                 )
         return self.head.root
+
+    def _maybe_migrate(self) -> None:
+        """Freeze + prune when finalization advances (migrate.rs trigger)."""
+        fin_epoch, fin_root = self.fork_choice.store.finalized_checkpoint
+        fin_slot = self.spec.start_slot(int(fin_epoch))
+        if fin_slot > self.migrator.last_finalized_slot and fin_root in self._states:
+            self.migrator.process_finalization(self, bytes(fin_root), fin_slot)
 
     # -- production -------------------------------------------------------------------
 

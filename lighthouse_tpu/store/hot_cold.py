@@ -1,24 +1,35 @@
 """Hot/cold split database (hot_cold_store.rs:51-81).
 
 Hot DB: recent states + all blocks since the split. Cold DB: finalized
-history — full state snapshots every ``slots_per_restore_point`` with
-zlib-compressed SSZ diff-bases in between (the hdiff layer will upgrade this
-to hierarchical binary diffs). States are keyed by state_root; block/state
-summaries let iterators walk ancestor chains without loading full states.
+history as a hierarchical-diff freezer (hdiff.py): full snapshots at the
+coarsest layer cadence, sectioned diffs between, block-replay for slots
+below the finest layer. Cold entries are keyed by SLOT; a root<->slot
+summary map serves by-root lookups.
 """
 
 from __future__ import annotations
 
 import zlib
-from dataclasses import dataclass
+from collections import OrderedDict
+from dataclasses import dataclass, field
 
+from .hdiff import (
+    DiffFrom,
+    HDiff,
+    HDiffBuffer,
+    HierarchyConfig,
+    ReplayFrom,
+    Snapshot,
+    storage_strategy,
+)
 from .kv import DBColumn, KeyValueStore, MemoryStore
 
 
 @dataclass
 class StoreConfig:
-    slots_per_restore_point: int = 32
     compression_level: int = 1
+    hierarchy: HierarchyConfig = field(default_factory=HierarchyConfig)
+    buffer_cache_size: int = 4
 
 
 @dataclass
@@ -43,6 +54,11 @@ class HotColdDB:
         self.cold = cold or MemoryStore()
         self.config = config or StoreConfig()
         self.split = Split()
+        self._buffer_cache: OrderedDict[int, HDiffBuffer] = OrderedDict()
+        from .metadata import apply_schema_migrations, check_config_consistency
+
+        apply_schema_migrations(self)
+        check_config_consistency(self, self.config.hierarchy.exponents)
 
     # -- blocks -----------------------------------------------------------------
 
@@ -82,39 +98,142 @@ class HotColdDB:
         self.hot.delete(DBColumn.BeaconState, state_root)
         self.hot.delete(DBColumn.BeaconStateSummary, state_root)
 
-    # -- cold states (freezer) ----------------------------------------------------
+    # -- cold states (freezer, hierarchical diffs) --------------------------------
 
-    def migrate_to_cold(self, state_root: bytes, slot: int) -> None:
-        """Move a finalized state hot -> cold. Snapshot at restore points,
-        compressed full-state otherwise (diff chain upgrade pending)."""
-        ssz = self.hot.get(DBColumn.BeaconState, state_root)
-        if ssz is None:
-            return
-        compressed = zlib.compress(ssz, self.config.compression_level)
-        col = (
-            DBColumn.ColdState
-            if slot % self.config.slots_per_restore_point == 0
-            else DBColumn.ColdStateDiff
-        )
-        self.cold.put(col, state_root, compressed)
+    @staticmethod
+    def _slot_key(slot: int) -> bytes:
+        return slot.to_bytes(8, "big")
+
+    def store_cold_state(self, state, state_root: bytes, block_root: bytes) -> None:
+        """Freeze a finalized state per its layer strategy: snapshot /
+        diff-vs-parent-layer / summary-only (replayed on read). Also records
+        slot<->root maps and the canonical slot->block_root chain the
+        replayer walks (hot_cold_store.rs store_cold_state*)."""
+        slot = int(state.slot)
+        strategy = storage_strategy(self.config.hierarchy, slot)
+        if isinstance(strategy, ReplayFrom) and not self._has_cold_state(
+            strategy.slot
+        ) and not self._has_cold_state(self.replay_anchor(slot)):
+            # the replay layer has no reachable anchor below (skipped-slot
+            # hole): store a diff at the finest layer instead of losing it
+            strategy = DiffFrom(slot - slot % self.config.hierarchy.moduli[0])
+        if isinstance(strategy, Snapshot):
+            ssz = type(state).encode(state)
+            self.cold.put(
+                DBColumn.ColdState,
+                self._slot_key(slot),
+                zlib.compress(ssz, self.config.compression_level),
+            )
+        elif isinstance(strategy, DiffFrom):
+            base = self._cold_buffer(strategy.slot)
+            if base is None:
+                # parent layer missing (pre-genesis-anchor history): snapshot
+                ssz = type(state).encode(state)
+                self.cold.put(
+                    DBColumn.ColdState,
+                    self._slot_key(slot),
+                    zlib.compress(ssz, self.config.compression_level),
+                )
+            else:
+                target = HDiffBuffer.from_state(state)
+                diff = HDiff.compute(base, target)
+                self.cold.put(
+                    DBColumn.ColdStateDiff, self._slot_key(slot), diff.blob
+                )
+        # ReplayFrom: state bytes not stored; the summary alone suffices
         self.cold.put(
-            DBColumn.BeaconStateSummary, slot.to_bytes(8, "little"), state_root
+            DBColumn.BeaconStateSummary,
+            self._slot_key(slot),
+            state_root + block_root,
         )
-        self.delete_state(state_root)
+        self.cold.put(DBColumn.BeaconStateSummary, state_root, self._slot_key(slot))
         if slot > self.split.slot:
             self.split = Split(slot=slot, state_root=state_root)
 
-    def load_cold_state(self, state_root: bytes) -> bytes | None:
-        for col in (DBColumn.ColdState, DBColumn.ColdStateDiff):
-            c = self.cold.get(col, state_root)
-            if c is not None:
-                return zlib.decompress(c)
-        return None
+    def _cold_buffer(self, slot: int) -> HDiffBuffer | None:
+        """Reconstruct the HDiffBuffer at a stored layer slot (snapshot +
+        diff chain), with a small LRU for repeated freezes."""
+        cached = self._buffer_cache.get(slot)
+        if cached is not None:
+            self._buffer_cache.move_to_end(slot)
+            return cached
+        strategy = storage_strategy(self.config.hierarchy, slot)
+        blob = self.cold.get(DBColumn.ColdState, self._slot_key(slot))
+        if blob is not None:
+            state_cls = self._state_cls_at(slot)
+            if state_cls is None:
+                return None
+            buf = HDiffBuffer.from_state(
+                state_cls.decode(zlib.decompress(blob))
+            )
+        elif isinstance(strategy, DiffFrom):
+            diff_blob = self.cold.get(
+                DBColumn.ColdStateDiff, self._slot_key(slot)
+            )
+            base = self._cold_buffer(strategy.slot)
+            if diff_blob is None or base is None:
+                return None
+            buf = HDiff(diff_blob).apply(base)
+        else:
+            return None
+        self._buffer_cache[slot] = buf
+        while len(self._buffer_cache) > self.config.buffer_cache_size:
+            self._buffer_cache.popitem(last=False)
+        return buf
 
-    def cold_state_root_at_slot(self, slot: int) -> bytes | None:
-        return self.cold.get(
-            DBColumn.BeaconStateSummary, slot.to_bytes(8, "little")
+    # fork-aware decoding hook: the chain sets this to map slot -> state class
+    state_cls_for_slot = None
+
+    def _state_cls_at(self, slot: int):
+        if self.state_cls_for_slot is None:
+            return None
+        return self.state_cls_for_slot(slot)
+
+    def get_cold_state(self, slot: int):
+        """Typed state at a stored cold slot, or None (slots on a replay
+        layer return None — use replay_anchor + block replay)."""
+        buf = self._cold_buffer(slot)
+        if buf is None:
+            return None
+        cls = self._state_cls_at(slot)
+        return buf.into_state(cls) if cls else None
+
+    def replay_anchor(self, slot: int) -> int:
+        """Closest slot at or below ``slot`` with actually-stored state
+        bytes. The nominal layer slot can be a hole when it was skipped
+        (no block, so no post-state was ever frozen there) — walk down
+        until a stored snapshot/diff exists."""
+        s = storage_strategy(self.config.hierarchy, slot)
+        anchor = s.slot if isinstance(s, ReplayFrom) else slot
+        while anchor > 0 and not self._has_cold_state(anchor):
+            anchor -= 1
+        return anchor
+
+    def _has_cold_state(self, slot: int) -> bool:
+        key = self._slot_key(slot)
+        return (
+            self.cold.exists(DBColumn.ColdState, key)
+            or self.cold.exists(DBColumn.ColdStateDiff, key)
         )
+
+    def cold_slot_for_root(self, state_root: bytes) -> int | None:
+        raw = self.cold.get(DBColumn.BeaconStateSummary, state_root)
+        return int.from_bytes(raw, "big") if raw else None
+
+    def cold_summary_at_slot(self, slot: int):
+        """(state_root, block_root) recorded when the slot froze."""
+        raw = self.cold.get(DBColumn.BeaconStateSummary, self._slot_key(slot))
+        if raw is None or len(raw) != 64:
+            return None
+        return raw[:32], raw[32:]
+
+    def load_cold_state(self, state_root: bytes) -> bytes | None:
+        """By-root cold lookup returning SSZ bytes (compat shim)."""
+        slot = self.cold_slot_for_root(state_root)
+        if slot is None:
+            return None
+        state = self.get_cold_state(slot)
+        return type(state).encode(state) if state is not None else None
 
     # -- metadata ----------------------------------------------------------------
 

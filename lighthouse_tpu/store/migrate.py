@@ -1,0 +1,63 @@
+"""Finalization migrator (ref store/src/migrate.rs + BackgroundMigrator).
+
+On finalization advance: freeze the canonical finalized states into the
+cold hierarchy, delete non-canonical (abandoned-fork) hot data, and release
+the chain's in-memory state handles — the fix for unbounded `_states`
+growth. The reference runs this on a background thread; here it runs
+inline under the chain lock (the freeze itself is a handful of diffs).
+"""
+
+from __future__ import annotations
+
+
+class BackgroundMigrator:
+    def __init__(self, store):
+        self.store = store
+        self.last_finalized_slot = 0
+
+    def process_finalization(self, chain, finalized_root: bytes, finalized_slot: int) -> dict:
+        """Migrate everything strictly below the finalized slot.
+
+        ``chain`` supplies the in-memory block/state maps; canonicality is
+        decided by walking parent links from the finalized block.
+        """
+        if finalized_slot <= self.last_finalized_slot:
+            return {"frozen": 0, "pruned": 0}
+
+        # canonical ancestor roots of the finalized block (incl. itself)
+        canonical = set()
+        root = finalized_root
+        while root in chain._blocks:
+            canonical.add(root)
+            root = bytes(chain._blocks[root].message.parent_root)
+        canonical.add(chain.genesis_block_root)
+
+        frozen = pruned = 0
+        for block_root in list(chain._states):
+            if block_root == chain.genesis_block_root:
+                continue  # the genesis anchor stays resident
+            state = chain._states[block_root]
+            slot = int(state.slot)
+            if slot >= finalized_slot or block_root == finalized_root:
+                continue
+            if block_root in canonical:
+                state_root = state.tree_root()
+                self.store.store_cold_state(state, state_root, block_root)
+                self.store.delete_state(state_root)
+                # the signed block stays in the store; drop the decoded
+                # in-memory copy (bounds _blocks alongside _states)
+                chain._blocks.pop(block_root, None)
+                frozen += 1
+            else:
+                # abandoned fork: drop block + state entirely (migrate.rs
+                # abandoned-forks pruning)
+                blk = chain._blocks.get(block_root)
+                if blk is not None:
+                    self.store.delete_block(block_root)
+                state_root = state.tree_root()
+                self.store.delete_state(state_root)
+                chain._blocks.pop(block_root, None)
+                pruned += 1
+            del chain._states[block_root]
+        self.last_finalized_slot = finalized_slot
+        return {"frozen": frozen, "pruned": pruned}

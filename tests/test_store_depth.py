@@ -1,0 +1,174 @@
+"""Store depth: hierarchical diffs, finalization migrator, schema guard,
+block replayer (refs: store/src/{hdiff.rs,migrate.rs,metadata.rs},
+state_processing block_replayer.rs).
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import BeaconChain
+from lighthouse_tpu.state_transition.block_replayer import BlockReplayer
+from lighthouse_tpu.store.hdiff import (
+    DiffFrom,
+    HDiff,
+    HDiffBuffer,
+    HierarchyConfig,
+    ReplayFrom,
+    Snapshot,
+    storage_strategy,
+)
+from lighthouse_tpu.store.hot_cold import HotColdDB, StoreConfig
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+class TestHierarchy:
+    def test_strategy_layers(self):
+        cfg = HierarchyConfig(exponents=(1, 3, 5))
+        assert storage_strategy(cfg, 0) == Snapshot()
+        assert storage_strategy(cfg, 32) == Snapshot()
+        assert storage_strategy(cfg, 8) == DiffFrom(0)
+        assert storage_strategy(cfg, 40) == DiffFrom(32)
+        assert storage_strategy(cfg, 2) == DiffFrom(0)
+        assert storage_strategy(cfg, 10) == DiffFrom(8)
+        assert storage_strategy(cfg, 3) == ReplayFrom(2)
+        assert storage_strategy(cfg, 41) == ReplayFrom(40)
+
+    def test_ascending_required(self):
+        with pytest.raises(ValueError):
+            HierarchyConfig(exponents=(5, 5))
+
+
+class TestHDiff:
+    def test_roundtrip_across_epochs(self):
+        spec = minimal_spec(altair_fork_epoch=0)
+        h = StateHarness(spec, 16)
+        base_state = h.state.copy()
+        h.extend_chain(2 * spec.preset.SLOTS_PER_EPOCH)
+        target_state = h.state
+
+        base = HDiffBuffer.from_state(base_state)
+        target = HDiffBuffer.from_state(target_state)
+        diff = HDiff.compute(base, target)
+        rebuilt = diff.apply(base).into_state(type(target_state))
+        assert rebuilt.tree_root() == target_state.tree_root()
+        # the diff is much smaller than the full state
+        full = len(type(target_state).encode(target_state))
+        assert len(diff.blob) < full // 2
+
+    def test_diff_chain(self):
+        spec = minimal_spec(altair_fork_epoch=0)
+        h = StateHarness(spec, 16)
+        s0 = h.state.copy()
+        h.extend_chain(4)
+        s1 = h.state.copy()
+        h.extend_chain(4)
+        s2 = h.state
+        b0 = HDiffBuffer.from_state(s0)
+        d01 = HDiff.compute(b0, HDiffBuffer.from_state(s1))
+        b1 = d01.apply(b0)
+        d12 = HDiff.compute(b1, HDiffBuffer.from_state(s2))
+        rebuilt = d12.apply(b1).into_state(type(s2))
+        assert rebuilt.tree_root() == s2.tree_root()
+
+
+class TestFreezer:
+    def _db(self):
+        cfg = StoreConfig(hierarchy=HierarchyConfig(exponents=(1, 3, 5)))
+        return HotColdDB(config=cfg)
+
+    def test_snapshot_and_diff_reconstruction(self):
+        spec = minimal_spec(altair_fork_epoch=0)
+        h = StateHarness(spec, 16)
+        db = self._db()
+        db.state_cls_for_slot = lambda slot: h.ns.state_types[
+            spec.fork_name_at_slot(slot)
+        ]
+        states = {}
+        # snapshot slot 0 then advance; freeze every even slot (diff layer)
+        db.store_cold_state(h.state, h.state.tree_root(), b"\x00" * 32)
+        for _ in range(10):
+            h.extend_chain(1)
+            slot = int(h.state.slot)
+            states[slot] = h.state.copy()
+            db.store_cold_state(h.state, h.state.tree_root(), b"\x01" * 32)
+        for slot, st in states.items():
+            got = db.get_cold_state(slot)
+            if got is None:  # replay layer: anchor must be at/below
+                assert db.replay_anchor(slot) < slot
+            else:
+                assert got.tree_root() == st.tree_root()
+
+    def test_schema_guard(self):
+        db = self._db()
+        from lighthouse_tpu.store.metadata import check_config_consistency
+
+        with pytest.raises(RuntimeError):
+            check_config_consistency(db, (2, 4, 6))
+
+
+class TestMigratorThroughChain:
+    def test_finalization_freezes_and_prunes_states(self):
+        spec = minimal_spec(altair_fork_epoch=0)
+        h = StateHarness(spec, 16)
+        clock = ManualSlotClock(0)
+        cfg = StoreConfig(hierarchy=HierarchyConfig(exponents=(1, 3, 5)))
+        chain = BeaconChain(
+            spec, h.state.copy(), store=HotColdDB(config=cfg), slot_clock=clock
+        )
+        spe = spec.preset.SLOTS_PER_EPOCH
+        for slot in range(1, 5 * spe + 1):
+            clock.set_slot(slot)
+            atts = []
+            if slot > 1:
+                atts = h.attestations_for_slot(
+                    h.state, h.state.slot, h.head_root(h.state)
+                )
+            block = h.produce_block(slot, attestations=atts)
+            h.apply_block(block)
+            chain.process_block(block)
+        fin = int(chain.head.state.finalized_checkpoint.epoch)
+        assert fin >= 2
+        # in-memory states are bounded: everything below the finalized slot
+        # was migrated out (the round-1 unbounded-_states fix)
+        fin_slot = spec.start_slot(fin)
+        held = [int(s.slot) for s in chain._states.values()]
+        assert all(s >= fin_slot or s == 0 for s in held), held
+        assert len(held) <= 5 * spe - fin_slot + 2
+        # frozen states reload through the store fallback
+        some_root = next(
+            r for r, b in chain._blocks.items()
+            if 0 < int(b.message.slot) < fin_slot
+        ) if any(0 < int(b.message.slot) < fin_slot for b in chain._blocks.values()) else None
+        if some_root is not None:
+            st = chain.state_by_root(some_root)
+            assert st is not None
+
+
+class TestBlockReplayer:
+    def test_replay_matches_direct_application(self):
+        spec = minimal_spec(altair_fork_epoch=0)
+        h = StateHarness(spec, 16)
+        base = h.state.copy()
+        blocks = []
+        for slot in range(1, 6):
+            b = h.produce_block(slot)
+            h.apply_block(b)
+            blocks.append(b)
+        replayed = BlockReplayer(spec, base.copy()).apply_blocks(blocks).state
+        assert replayed.tree_root() == h.state.tree_root()
+        # target_slot advances past the last block
+        replayed2 = (
+            BlockReplayer(spec, base.copy()).apply_blocks(blocks, 8).state
+        )
+        assert int(replayed2.slot) == 8
