@@ -20,10 +20,27 @@ try:
     # this host compiles them slowly; warm runs (tests, benches, the chain)
     # must not re-pay compilation. Opt out with LIGHTHOUSE_TPU_NO_JIT_CACHE=1.
     if not _os.environ.get("LIGHTHOUSE_TPU_NO_JIT_CACHE"):
+        # Partition by host CPU fingerprint: the workspace survives across
+        # machines, and XLA:CPU AOT executables compiled for another host's
+        # feature set abort at run time (cpu_aot_loader SIGILL warning).
+        def _host_tag() -> str:
+            import hashlib as _hl
+
+            try:
+                with open("/proc/cpuinfo") as _fh:
+                    for _line in _fh:
+                        if _line.startswith("flags"):
+                            return _hl.sha256(_line.encode()).hexdigest()[:12]
+            except OSError:
+                pass
+            import platform as _pl
+
+            return _hl.sha256(_pl.processor().encode()).hexdigest()[:12]
+
         _cache_dir = _os.environ.get(
             "LIGHTHOUSE_TPU_JIT_CACHE",
             _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                          _os.pardir, ".jax_cache"),
+                          _os.pardir, ".jax_cache", _host_tag()),
         )
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
@@ -31,4 +48,4 @@ try:
 except ImportError:  # the pure-Python oracle backend works without jax
     pass
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

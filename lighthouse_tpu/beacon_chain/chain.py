@@ -30,8 +30,17 @@ from ..state_transition.per_block import BlockProcessingError, ConsensusContext
 from ..store import HotColdDB
 from ..types.containers import for_preset
 from ..types.spec import ChainSpec
+from ..utils.logging import get_logger
+from ..utils.metrics import (
+    ATTESTATION_BATCH_SETUP_TIMES,
+    ATTESTATION_BATCH_VERIFY_TIMES,
+    BLOCK_PROCESSING_TIMES,
+    FORK_CHOICE_GET_HEAD_TIMES,
+)
 from ..utils.slot_clock import ManualSlotClock, SlotClock
 from .pubkey_cache import ValidatorPubkeyCache
+
+log = get_logger("beacon_chain")
 
 
 class BlockError(Exception):
@@ -117,6 +126,27 @@ class BeaconChain:
             root=genesis_root, slot=genesis_state.slot, state=genesis_state
         )
         self._seen_blocks: set[bytes] = {genesis_root}
+        # Ingest seams for auxiliary services (the reference's slasher
+        # service subscribes to gossip/import events, service.rs): called
+        # with (signed_block) after import / (indexed_attestation) after
+        # successful gossip verification. Observer errors never fail the
+        # hot path.
+        self.block_observers: list = []
+        self.attestation_observers: list = []
+
+    def _notify_block_observers(self, signed_block) -> None:
+        for obs in self.block_observers:
+            try:
+                obs(signed_block)
+            except Exception:
+                pass
+
+    def _notify_attestation_observers(self, indexed) -> None:
+        for obs in self.attestation_observers:
+            try:
+                obs(indexed)
+            except Exception:
+                pass
 
     # -- time --------------------------------------------------------------------
 
@@ -208,10 +238,14 @@ class BeaconChain:
         fork choice. Returns the block root."""
         block = signed_block.message
         block_root = type(block).hash_tree_root(block)
-        with self.lock:
-            return self._process_block_locked(
+        with self.lock, BLOCK_PROCESSING_TIMES.time():
+            root = self._process_block_locked(
                 signed_block, block, block_root, is_first_block_in_slot
             )
+        log.debug(
+            "Block imported", slot=int(block.slot), root=block_root.hex()[:16]
+        )
+        return root
 
     def _process_block_locked(
         self,
@@ -250,6 +284,7 @@ class BeaconChain:
             is_first_block_in_slot=is_first_block_in_slot,
             execution_status=execution_status,
         )
+        self._notify_block_observers(signed_block)
         return block_root
 
     def process_gossip_blob(self, sidecar) -> bytes | None:
@@ -379,6 +414,9 @@ class BeaconChain:
                 sb, root, post_state, ctxt,
                 execution_status=self._notify_execution_layer(sb),
             )
+            # range-synced blocks carry slashing evidence too (the slasher
+            # subscription must see every import path, not just gossip)
+            self._notify_block_observers(sb)
             roots.append(root)
         return roots
 
@@ -442,6 +480,10 @@ class BeaconChain:
         generic SignatureSet seam."""
         if not items:
             return False
+        with ATTESTATION_BATCH_VERIFY_TIMES.time():
+            return self._batch_verify_items_inner(items)
+
+    def _batch_verify_items_inner(self, items) -> bool:
         if bls.get_backend() == "tpu":
             from ..bls import tpu_backend as tb
 
@@ -483,14 +525,15 @@ class BeaconChain:
         (batch_verify_unaggregated_attestations, batch.rs:133-211).
         Returns list of (attestation, indexed | error)."""
         prepared = []
-        for att in attestations:
-            try:
-                state = self._attestation_state(att)
-                indexed = get_indexed_attestation(self.spec, state, att)
-                item = self._attester_item(state, indexed)
-                prepared.append((att, indexed, item))
-            except Exception as e:
-                prepared.append((att, AttestationError(str(e)), None))
+        with ATTESTATION_BATCH_SETUP_TIMES.time():
+            for att in attestations:
+                try:
+                    state = self._attestation_state(att)
+                    indexed = get_indexed_attestation(self.spec, state, att)
+                    item = self._attester_item(state, indexed)
+                    prepared.append((att, indexed, item))
+                except Exception as e:
+                    prepared.append((att, AttestationError(str(e)), None))
         items = [p[2] for p in prepared if p[2] is not None]
         results = []
         if items and self._batch_verify_items(items):
@@ -516,6 +559,7 @@ class BeaconChain:
                         )
                     except Exception:
                         pass
+                    self._notify_attestation_observers(indexed)
         return results
 
     def verify_aggregated_attestations(self, signed_aggregates) -> list:
@@ -584,6 +628,7 @@ class BeaconChain:
                         )
                     except Exception:
                         pass
+                    self._notify_attestation_observers(indexed)
         return results
 
     def _attestation_state(self, att):
@@ -603,7 +648,8 @@ class BeaconChain:
             return self._recompute_head_locked()
 
     def _recompute_head_locked(self) -> bytes:
-        head_root = self.fork_choice.get_head(self.current_slot())
+        with FORK_CHOICE_GET_HEAD_TIMES.time():
+            head_root = self.fork_choice.get_head(self.current_slot())
         self._maybe_migrate()
         if head_root != self.head.root:
             state = self._states.get(head_root)

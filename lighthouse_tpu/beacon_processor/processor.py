@@ -18,6 +18,8 @@ import threading
 from collections import deque
 from dataclasses import dataclass, field
 
+from ..utils.metrics import PROCESSOR_QUEUE_LENGTH, PROCESSOR_WORK_EVENTS
+
 
 class WorkType(enum.Enum):
     # priority order: lower value = higher priority (lib.rs manager match order)
@@ -134,6 +136,8 @@ class BeaconProcessor:
                 q.appendleft(work)
             else:
                 q.append(work)
+            PROCESSOR_WORK_EVENTS.inc(work_type=work.work_type.name)
+            PROCESSOR_QUEUE_LENGTH.set(len(q), work_type=work.work_type.name)
             self._work_ready.notify()
         if self.synchronous:
             self.run_until_idle()
@@ -152,8 +156,11 @@ class BeaconProcessor:
                 n = min(len(q), self.config.max_batch_size)
                 items = [q.popleft() for _ in range(n)]
                 self.batches_formed += 1
+                PROCESSOR_QUEUE_LENGTH.set(len(q), work_type=t.name)
                 return ("batch", t, items)
-            return ("one", t, q.popleft())
+            popped = q.popleft()
+            PROCESSOR_QUEUE_LENGTH.set(len(q), work_type=t.name)
+            return ("one", t, popped)
         return None
 
     def _execute(self, popped) -> None:

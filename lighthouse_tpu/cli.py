@@ -1,0 +1,194 @@
+"""Umbrella CLI (ref lighthouse/src/main.rs:88-481 + beacon_node/src/cli.rs).
+
+``python -m lighthouse_tpu <subcommand>``:
+
+  bn               run a beacon node (HTTP API + metrics + optional slasher)
+  vc               run a validator client against a beacon node
+  account-manager  create EIP-2335 validator keystores
+  version          print versions
+
+Global flags select the spec preset and debug level; the spec-at-runtime
+monomorphization of ``run::<E>()`` maps to preset selection here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import __version__
+
+
+def _spec(args):
+    from .types.spec import ChainSpec, mainnet_spec, minimal_spec
+
+    platform = getattr(args, "platform", "auto")
+    if platform != "auto":
+        # must land before the first device use (backend init is lazy; the
+        # package import itself only sets config flags)
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    backend = getattr(args, "bls_backend", None)
+    if backend:
+        from . import bls
+
+        bls.set_backend(backend)
+
+    kwargs = {}
+    for fork in ("altair", "bellatrix", "capella", "deneb", "electra"):
+        v = getattr(args, f"{fork}_fork_epoch", None)
+        if v is not None:
+            kwargs[f"{fork}_fork_epoch"] = v
+    if args.preset == "minimal":
+        return minimal_spec(**kwargs)
+    return mainnet_spec(**kwargs) if kwargs else mainnet_spec()
+
+
+def _add_spec_flags(p):
+    p.add_argument(
+        "--preset", choices=("mainnet", "minimal"), default="mainnet",
+        help="compile-time preset analog (EthSpec selection, main.rs:449)",
+    )
+    p.add_argument("--debug-level", default="info",
+                   choices=("debug", "info", "warning", "error"))
+    p.add_argument(
+        "--bls-backend", default=None, choices=("tpu", "native", "oracle"),
+        help="BLS backend (the reference's blst/fake_crypto cargo-feature "
+             "seam, crypto/bls/src/lib.rs:8-18): tpu = JAX device kernels "
+             "(the default), native = C++ CPU parity backend, oracle = pure "
+             "Python. Unset = keep the process's current backend.",
+    )
+    p.add_argument(
+        "--platform", default="auto", choices=("auto", "cpu", "tpu"),
+        help="JAX platform: 'cpu' forces host execution even where an "
+             "accelerator plugin force-selects itself (the devcpu.py recipe)",
+    )
+    for fork in ("altair", "bellatrix", "capella", "deneb", "electra"):
+        p.add_argument(f"--{fork}-fork-epoch", type=int, default=None)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="lighthouse_tpu", description="TPU-native consensus client"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="beacon node")
+    _add_spec_flags(bn)
+    bn.add_argument("--datadir", default=None)
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--disable-http", action="store_true")
+    bn.add_argument("--metrics", action="store_true")
+    bn.add_argument("--metrics-port", type=int, default=5054)
+    bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--interop-validators", type=int, default=64)
+    bn.add_argument("--genesis-time", type=int, default=None)
+
+    vc = sub.add_parser("vc", help="validator client")
+    _add_spec_flags(vc)
+    vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument("--validators-dir", default=None)
+    vc.add_argument("--password", default="")
+    vc.add_argument("--interop-validators", type=int, default=0)
+
+    am = sub.add_parser("account-manager", aliases=["am"],
+                        help="create validator keystores")
+    _add_spec_flags(am)
+    am.add_argument("--output-dir", required=True)
+    am.add_argument("--count", type=int, default=1)
+    am.add_argument("--password", required=True)
+    am.add_argument("--mnemonic-seed", default=None,
+                    help="hex seed for EIP-2333 derivation (random if unset)")
+
+    sub.add_parser("version", help="print version")
+    return parser
+
+
+def run_bn(args) -> "object":
+    from .client import ClientBuilder, ClientConfig
+
+    spec = _spec(args)
+    cfg = ClientConfig(
+        datadir=args.datadir,
+        http_enabled=not args.disable_http,
+        http_port=args.http_port,
+        metrics_enabled=args.metrics,
+        metrics_port=args.metrics_port,
+        slasher_enabled=args.slasher,
+        interop_validators=args.interop_validators,
+        genesis_time=args.genesis_time,
+        debug_level=args.debug_level,
+    )
+    return ClientBuilder(spec, cfg).build().start()
+
+
+def run_vc(args):
+    from .utils.logging import init_logging
+    from .validator_client.runner import ProductionValidatorClient
+
+    init_logging(args.debug_level)
+    spec = _spec(args)
+    vc = ProductionValidatorClient(spec, args.beacon_node)
+    if args.validators_dir:
+        vc.load_keystore_dir(args.validators_dir, args.password)
+    if args.interop_validators:
+        vc.load_interop_keys(args.interop_validators)
+    return vc.connect()
+
+
+def run_account_manager(args) -> list[str]:
+    """Derive EIP-2333 keys and write EIP-2335 keystores
+    (account_manager validator create)."""
+    from .keys.derivation import derive_sk_from_path
+    from .keys.keystore import Keystore
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    seed = (
+        bytes.fromhex(args.mnemonic_seed)
+        if args.mnemonic_seed
+        else os.urandom(32)
+    )
+    written = []
+    for i in range(args.count):
+        path = f"m/12381/3600/{i}/0/0"
+        sk = derive_sk_from_path(seed, path)
+        ks = Keystore.encrypt(
+            sk.to_bytes(32, "big"),
+            args.password,
+            path=path,
+        )
+        name = f"keystore-{i}.json"
+        with open(os.path.join(args.output_dir, name), "w") as fh:
+            fh.write(ks.to_json())
+        written.append(name)
+    print(json.dumps({"wrote": written, "dir": args.output_dir}))
+    return written
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        print(f"lighthouse_tpu/{__version__}")
+        return 0
+    if args.command == "bn":
+        client = run_bn(args)
+        client.wait_for_shutdown()
+        return 0
+    if args.command == "vc":
+        vc = run_vc(args)
+        try:
+            vc.run()
+        except KeyboardInterrupt:
+            vc.stop()
+        return 0
+    if args.command in ("account-manager", "am"):
+        run_account_manager(args)
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""Beacon-node client assembly (ref beacon_node/client/src/builder.rs:74-786
++ beacon_node/src/lib.rs ProductionBeaconNode).
+
+``ClientBuilder`` chains the same construction steps the reference does —
+chain, processor, network service, HTTP API, metrics, slasher, notifier —
+and ``Client`` owns their lifecycles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..beacon_chain.chain import BeaconChain
+from ..op_pool import OperationPool
+from ..store.hot_cold import HotColdDB, StoreConfig
+from ..store.kv import LevelStore
+from ..types.spec import ChainSpec
+from ..utils.logging import get_logger, init_logging
+from ..utils.slot_clock import ManualSlotClock, SystemTimeSlotClock
+from .notifier import Notifier
+
+log = get_logger("client")
+
+
+@dataclass
+class ClientConfig:
+    datadir: str | None = None  # None = in-memory stores
+    http_enabled: bool = True
+    http_port: int = 0  # 0 = ephemeral
+    metrics_enabled: bool = False
+    metrics_port: int = 0
+    slasher_enabled: bool = False
+    interop_validators: int = 16
+    genesis_time: int | None = None  # None = now
+    debug_level: str = "info"
+    use_system_clock: bool = True
+
+
+class Client:
+    def __init__(self, chain, op_pool, http_server, metrics_server,
+                 slasher_service, notifier, network_service=None):
+        self.chain = chain
+        self.op_pool = op_pool
+        self.http_server = http_server
+        self.metrics_server = metrics_server
+        self.slasher_service = slasher_service
+        self.notifier = notifier
+        self.network_service = network_service
+        self._shutdown = threading.Event()
+
+    def start(self) -> "Client":
+        if self.http_server is not None:
+            self.http_server.start()
+            log.info("Beacon API started", url=self.http_server.url)
+        if self.metrics_server is not None:
+            self.metrics_server.start()
+            log.info("Metrics server started", url=self.metrics_server.url)
+        if self.notifier is not None:
+            self.notifier.start()
+        if self.slasher_service is not None:
+            self._slasher_ticker = threading.Thread(
+                target=self._run_slasher_ticks, daemon=True,
+                name="slasher-tick",
+            )
+            self._slasher_ticker.start()
+        threading.Thread(
+            target=self._warmup_bls, daemon=True, name="bls-warmup"
+        ).start()
+        return self
+
+    def _run_slasher_ticks(self) -> None:
+        """Per-slot slasher batch processing (the reference's timer task at
+        slot_offset into each slot, slasher/service/src/service.rs)."""
+        sps = self.chain.spec.preset.SECONDS_PER_SLOT
+        while not self._shutdown.wait(sps):
+            try:
+                self.slasher_service.tick()
+            except Exception as e:  # noqa: BLE001 — keep the timer alive
+                log.warning("Slasher tick failed", error=str(e))
+
+    def _warmup_bls(self) -> None:
+        """Compile the verification kernels off the serving path so the first
+        block publish doesn't pay XLA compilation inside an HTTP request."""
+        from .. import bls
+
+        try:
+            t0 = time.monotonic()
+            ok = bls.warmup()
+            if bls.get_backend() == "tpu":
+                import hashlib
+
+                from ..bls import tpu_backend as tb
+
+                root = hashlib.sha256(b"lighthouse-tpu-warmup").digest()
+                sk = bls.SecretKey.from_bytes((7).to_bytes(32, "big"))
+                sig = sk.sign(root).serialize()
+                tb.verify_indexed_sets_device(
+                    self.chain.pubkey_cache.device_array(),
+                    [([0], root, sig)],
+                )
+            log.info(
+                "BLS backend warm",
+                backend=bls.get_backend(),
+                healthy=ok,
+                seconds=round(time.monotonic() - t0, 1),
+            )
+        except Exception as e:  # noqa: BLE001 — warmup is best-effort
+            log.warning("BLS warmup failed", error=str(e))
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        if self.notifier is not None:
+            self.notifier.stop()
+        if self.http_server is not None:
+            self.http_server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+
+    def wait_for_shutdown(self) -> None:
+        """Block until stop() or KeyboardInterrupt (Environment's shutdown
+        channel, common/task_executor/src/lib.rs:205)."""
+        try:
+            while not self._shutdown.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            log.info("Shutting down", reason="interrupt")
+            self.stop()
+
+
+class ClientBuilder:
+    def __init__(self, spec: ChainSpec, config: ClientConfig | None = None):
+        self.spec = spec
+        self.config = config or ClientConfig()
+        self._genesis_state = None
+        self._slot_clock = None
+
+    def interop_genesis(self) -> "ClientBuilder":
+        from ..state_transition.genesis import interop_genesis_state
+
+        genesis_time = (
+            int(time.time())
+            if self.config.genesis_time is None
+            else self.config.genesis_time
+        )
+        self._genesis_state = interop_genesis_state(
+            self.spec, self.config.interop_validators, genesis_time
+        )
+        return self
+
+    def genesis_state(self, state) -> "ClientBuilder":
+        """Boot from a provided state (the checkpoint-sync seam:
+        client/src/builder.rs genesis-state branch)."""
+        self._genesis_state = state
+        return self
+
+    def slot_clock(self, clock) -> "ClientBuilder":
+        self._slot_clock = clock
+        return self
+
+    def build(self) -> Client:
+        cfg = self.config
+        init_logging(cfg.debug_level)
+        if self._genesis_state is None:
+            self.interop_genesis()
+        state = self._genesis_state
+
+        if cfg.datadir:
+            import os
+
+            os.makedirs(cfg.datadir, exist_ok=True)
+            store = HotColdDB(
+                hot=LevelStore(os.path.join(cfg.datadir, "chain.db")),
+                cold=LevelStore(os.path.join(cfg.datadir, "freezer.db")),
+                config=StoreConfig(),
+            )
+        else:
+            store = HotColdDB()
+
+        clock = self._slot_clock
+        if clock is None:
+            clock = (
+                SystemTimeSlotClock(
+                    int(state.genesis_time), self.spec.preset.SECONDS_PER_SLOT
+                )
+                if cfg.use_system_clock
+                else ManualSlotClock(0)
+            )
+        chain = BeaconChain(self.spec, state, store=store, slot_clock=clock)
+        op_pool = OperationPool(self.spec, chain.ns.Attestation)
+
+        http_server = None
+        if cfg.http_enabled:
+            from ..http_api import BeaconApiServer
+
+            http_server = BeaconApiServer(
+                chain, op_pool=op_pool, port=cfg.http_port
+            )
+
+        metrics_server = None
+        if cfg.metrics_enabled:
+            from ..http_metrics import MetricsServer
+
+            metrics_server = MetricsServer(port=cfg.metrics_port)
+
+        slasher_service = None
+        if cfg.slasher_enabled:
+            from ..slasher import Slasher, SlasherService
+
+            slasher = Slasher(store.hot, chain.ns)
+            slasher_service = SlasherService(chain, slasher, op_pool)
+            # subscribe to the chain's ingest seams (service.rs gossip taps)
+            chain.block_observers.append(slasher_service.block_observed)
+            chain.attestation_observers.append(
+                slasher_service.attestation_observed
+            )
+
+        notifier = Notifier(chain)
+        return Client(
+            chain, op_pool, http_server, metrics_server, slasher_service,
+            notifier,
+        )

@@ -201,14 +201,16 @@ class BeaconApiServer:
 
     def get_attestation_data(self, slot: int, committee_index: int):
         spec = self.chain.spec
-        chain = self.chain
-        state = chain.head.state
+        # one snapshot: a concurrent import swaps chain.head atomically, so
+        # every field here must come from the SAME head view
+        head = self.chain.head
+        state = head.state
         if state.slot < slot:
             state = state.copy()
             process_slots(spec, state, slot)
         epoch = slot // spec.preset.SLOTS_PER_EPOCH
-        head_root = chain.head.root
-        if slot == spec.start_slot(epoch) and chain.head.slot <= slot:
+        head_root = head.root
+        if slot == spec.start_slot(epoch) and head.slot <= slot:
             target_root = head_root
         else:
             from ..state_transition import get_block_root_at_slot
@@ -344,6 +346,10 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
 ]
 
+# Routes that mutate chain state and therefore serialize on the chain's
+# mutation lock. Everything else reads immutable snapshots.
+_MUTATING = {"publish_block", "publish_atts"}
+
 
 def _make_handler(api: BeaconApiServer):
     class Handler(BaseHTTPRequestHandler):
@@ -375,7 +381,15 @@ def _make_handler(api: BeaconApiServer):
                     if not match:
                         continue
                     q = {k: v[0] for k, v in parse_qs(u.query).items()}
-                    with api._chain_lock:
+                    if name in _MUTATING:
+                        # Only mutation routes serialize on the chain lock;
+                        # reads work from the atomically-swapped head snapshot
+                        # (the reference's cached head view, canonical_head.rs
+                        # :474-497), so duties stay responsive while a block
+                        # import runs BLS verification.
+                        with api._chain_lock:
+                            out = self._route(name, match, q)
+                    else:
                         out = self._route(name, match, q)
                     self._reply(200, {"data": out} if name != "produce_block" else out)
                     return
@@ -397,7 +411,9 @@ def _make_handler(api: BeaconApiServer):
             if name == "syncing":
                 return api.get_syncing()
             if name == "version":
-                return {"version": "lighthouse_tpu/0.1.0"}
+                from .. import __version__
+
+                return {"version": f"lighthouse_tpu/{__version__}"}
             if name == "proposer":
                 return api.get_proposer_duties(int(match.group(1)))
             if name == "attester":

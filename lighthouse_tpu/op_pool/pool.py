@@ -8,6 +8,8 @@ dedupe by their slashable targets (lib.rs:388)."""
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..ops.bls_oracle import curves as oc
@@ -28,10 +30,18 @@ class OperationPool:
         self._attester_slashings: list = []
         self._proposer_slashings: dict[int, object] = {}
         self._voluntary_exits: dict[int, object] = {}
+        # The reference wraps each map in its own RwLock (lib.rs:48-60);
+        # here one pool lock serializes inserts (HTTP publishers) against
+        # packing reads (block production).
+        self._lock = threading.RLock()
 
     # -- attestations (insert_attestation, lib.rs:200) ---------------------------
 
     def insert_attestation(self, attestation) -> None:
+        with self._lock:
+            self._insert_attestation(attestation)
+
+    def _insert_attestation(self, attestation) -> None:
         data = attestation.data
         root = type(data).hash_tree_root(data)
         bits = np.asarray(attestation.aggregation_bits, dtype=bool)
@@ -50,7 +60,8 @@ class OperationPool:
         variants.append((bits, sig))
 
     def num_attestations(self) -> int:
-        return sum(len(v) for _, v in self._attestations.values())
+        with self._lock:
+            return sum(len(v) for _, v in self._attestations.values())
 
     def get_attestations(self, state, ctxt_reward_fn=None) -> list:
         """Max-cover packed attestations valid for inclusion in a block built
@@ -59,7 +70,12 @@ class OperationPool:
         cur, prev = get_current_epoch(spec, state), get_previous_epoch(spec, state)
         candidates = []
         n_val = len(state.validators)
-        for data, variants in self._attestations.values():
+        with self._lock:
+            entries = [
+                (data, [(b.copy(), s) for b, s in variants])
+                for data, variants in self._attestations.values()
+            ]
+        for data, variants in entries:
             if data.target.epoch not in (cur, prev):
                 continue
             if not (
@@ -97,29 +113,36 @@ class OperationPool:
 
     def insert_proposer_slashing(self, slashing) -> None:
         idx = int(slashing.signed_header_1.message.proposer_index)
-        self._proposer_slashings.setdefault(idx, slashing)
+        with self._lock:
+            self._proposer_slashings.setdefault(idx, slashing)
 
     def insert_attester_slashing(self, slashing) -> None:
-        self._attester_slashings.append(slashing)
+        with self._lock:
+            self._attester_slashings.append(slashing)
 
     def insert_voluntary_exit(self, exit_msg) -> None:
         idx = int(exit_msg.message.validator_index)
-        self._voluntary_exits.setdefault(idx, exit_msg)
+        with self._lock:
+            self._voluntary_exits.setdefault(idx, exit_msg)
 
     def get_slashings_and_exits(self, state):
         from ..types.helpers import is_slashable_validator
         from ..types.spec import FAR_FUTURE_EPOCH
 
         epoch = get_current_epoch(self.spec, state)
+        with self._lock:
+            proposer_items = list(self._proposer_slashings.items())
+            attester_slashings = list(self._attester_slashings)
+            exit_items = list(self._voluntary_exits.items())
         proposer = [
             s
-            for i, s in self._proposer_slashings.items()
+            for i, s in proposer_items
             if i < len(state.validators)
             and is_slashable_validator(state.validators[i], epoch)
         ][: self.spec.preset.MAX_PROPOSER_SLASHINGS]
         attester = []
         covered: set[int] = set()
-        for sl in self._attester_slashings:
+        for sl in attester_slashings:
             common = set(int(i) for i in sl.attestation_1.attesting_indices) & set(
                 int(i) for i in sl.attestation_2.attesting_indices
             )
@@ -137,7 +160,7 @@ class OperationPool:
                 break
         exits = [
             e
-            for i, e in self._voluntary_exits.items()
+            for i, e in exit_items
             if i < len(state.validators)
             and state.validators[i].exit_epoch == FAR_FUTURE_EPOCH
             and state.validators[i].activation_epoch != FAR_FUTURE_EPOCH
@@ -149,6 +172,10 @@ class OperationPool:
     def prune(self, state) -> None:
         """Drop attestations/ops no longer includable (prune_all, lib.rs)."""
         cur = get_current_epoch(self.spec, state)
+        with self._lock:
+            self._prune_locked(state, cur)
+
+    def _prune_locked(self, state, cur) -> None:
         self._attestations = {
             r: (d, v)
             for r, (d, v) in self._attestations.items()

@@ -141,6 +141,12 @@ class Slasher:
     def _emit_attester_slashing(self, surrounder, other) -> None:
         """attestation_1 must be the surrounding/existing attestation for the
         slashing to validate on chain (ref lib.rs:52-92)."""
+        from ..utils.logging import get_logger
+
+        get_logger("slasher").info(
+            "Found attester slashing",
+            target=int(other.data.target.epoch),
+        )
         t = self.types.AttesterSlashing
         slashing = t(attestation_1=surrounder, attestation_2=other)
         key = t.hash_tree_root(slashing)
@@ -186,6 +192,9 @@ class Slasher:
         new_rows, results = update_rows(
             rows, pairs, current_epoch, self.config.history_length
         )
+        from ..utils.metrics import SLASHER_CHUNKS_UPDATED
+
+        SLASHER_CHUNKS_UPDATED.inc(len(new_rows), array="minmax")
 
         found = 0
         for rid, (min_d, max_d), row_results in zip(row_ids, new_rows, results):

@@ -32,6 +32,8 @@ class BackgroundMigrator:
             root = bytes(chain._blocks[root].message.parent_root)
         canonical.add(chain.genesis_block_root)
 
+        from ..utils.metrics import STORE_FREEZE_TIMES
+
         frozen = pruned = 0
         for block_root in list(chain._states):
             if block_root == chain.genesis_block_root:
@@ -42,7 +44,8 @@ class BackgroundMigrator:
                 continue
             if block_root in canonical:
                 state_root = state.tree_root()
-                self.store.store_cold_state(state, state_root, block_root)
+                with STORE_FREEZE_TIMES.time():
+                    self.store.store_cold_state(state, state_root, block_root)
                 self.store.delete_state(state_root)
                 # the signed block stays in the store; drop the decoded
                 # in-memory copy (bounds _blocks alongside _states)
@@ -60,4 +63,10 @@ class BackgroundMigrator:
                 pruned += 1
             del chain._states[block_root]
         self.last_finalized_slot = finalized_slot
+        from ..utils.logging import get_logger
+
+        get_logger("store.migrate").info(
+            "Finalization migration",
+            finalized_slot=finalized_slot, frozen=frozen, pruned=pruned,
+        )
         return {"frozen": frozen, "pruned": pruned}

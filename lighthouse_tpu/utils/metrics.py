@@ -1,0 +1,207 @@
+"""Prometheus-style metrics registry (ref common/metrics + the per-subsystem
+``metrics.rs`` files; scraped by ``http_metrics``).
+
+Metric NAMES follow the reference so dashboards transfer — e.g. the
+attestation batch timers of ``attestation_verification/batch.rs:57,106``
+keep their ``beacon_attestation_batch_*`` families. Collectors are
+process-global and cheap enough for hot paths (a timer observe is a couple
+of dict ops); exposition is the Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: tuple = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self):
+        with self._lock:
+            items = list(self._values.items())
+        for key, v in items:
+            yield key, "", v
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self):
+        with self._lock:
+            items = list(self._values.items())
+        for key, v in items:
+            yield key, "", v
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, label_names=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    @contextmanager
+    def time(self, **labels):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0, **labels)
+
+    def collect(self):
+        with self._lock:
+            snapshot = [
+                (key, list(counts), self._totals[key], self._sums[key])
+                for key, counts in self._counts.items()
+            ]
+        for key, counts, total, total_sum in snapshot:
+            for b, c in zip(self.buckets, counts):
+                yield key, f'le="{b}"', c
+            yield key, 'le="+Inf"', total
+            yield key, "__sum__", total_sum
+            yield key, "__count__", total
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, cls, name, help_text, label_names=(), **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_text, label_names, **kw)
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name, help_text, label_names=()):
+        return self._register(Counter, name, help_text, label_names)
+
+    def gauge(self, name, help_text, label_names=()):
+        return self._register(Gauge, name, help_text, label_names)
+
+    def histogram(self, name, help_text, label_names=(), buckets=_DEFAULT_BUCKETS):
+        return self._register(
+            Histogram, name, help_text, label_names, buckets=buckets
+        )
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            for key, extra, value in m.collect():
+                labels = [
+                    f'{n}="{v}"' for n, v in zip(m.label_names, key) if v != ""
+                ]
+                if extra == "__sum__":
+                    name, labels_s = f"{m.name}_sum", ",".join(labels)
+                elif extra == "__count__":
+                    name, labels_s = f"{m.name}_count", ",".join(labels)
+                elif extra:
+                    name = f"{m.name}_bucket"
+                    labels_s = ",".join(labels + [extra])
+                else:
+                    name, labels_s = m.name, ",".join(labels)
+                body = f"{{{labels_s}}}" if labels_s else ""
+                out.append(f"{name}{body} {value}")
+        return "\n".join(out) + "\n"
+
+
+# process-global registry (the reference's lazy_static metric statics)
+REGISTRY = Registry()
+
+# -- canonical metric families (names mirror the reference) ----------------------
+
+BLOCK_PROCESSING_TIMES = REGISTRY.histogram(
+    "beacon_block_processing_seconds",
+    "Full runtime of block processing (beacon_chain/src/metrics.rs)",
+)
+ATTESTATION_BATCH_SETUP_TIMES = REGISTRY.histogram(
+    "beacon_attestation_batch_signature_setup_times",
+    "Batch attestation signature-set construction "
+    "(attestation_verification/batch.rs:57)",
+)
+ATTESTATION_BATCH_VERIFY_TIMES = REGISTRY.histogram(
+    "beacon_attestation_batch_signature_verify_times",
+    "Batch attestation signature verification "
+    "(attestation_verification/batch.rs:106)",
+)
+FORK_CHOICE_GET_HEAD_TIMES = REGISTRY.histogram(
+    "beacon_fork_choice_get_head_seconds",
+    "Fork-choice head computation",
+)
+PROCESSOR_WORK_EVENTS = REGISTRY.counter(
+    "beacon_processor_work_events_total",
+    "Work events accepted by the beacon processor",
+    label_names=("work_type",),
+)
+PROCESSOR_QUEUE_LENGTH = REGISTRY.gauge(
+    "beacon_processor_queue_length",
+    "Current per-work-type queue length",
+    label_names=("work_type",),
+)
+SLASHER_CHUNKS_UPDATED = REGISTRY.counter(
+    "slasher_chunks_updated_total",
+    "Slasher target-array rows updated (slasher/src/metrics.rs)",
+    label_names=("array",),
+)
+STORE_FREEZE_TIMES = REGISTRY.histogram(
+    "store_beacon_state_freeze_seconds",
+    "Cold-migration time per state (store/src/metrics.rs)",
+)

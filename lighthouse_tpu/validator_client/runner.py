@@ -1,0 +1,106 @@
+"""Production validator client runner (ref validator_client/src/lib.rs:77-107
+ProductionValidatorClient).
+
+Loads keys (interop range or EIP-2335 keystore directory), connects to a
+beacon node over HTTP only, and drives the duties/attestation/block services
+slot by slot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .. import bls
+from ..api_client import BeaconNodeHttpClient
+from ..state_transition.genesis import interop_secret_keys
+from ..utils.logging import get_logger
+from .services import (
+    AttestationService,
+    BlockService,
+    DutiesService,
+    ValidatorClientContext,
+)
+from .validator_store import ValidatorStore
+
+log = get_logger("validator_client")
+
+
+class ProductionValidatorClient:
+    def __init__(self, spec, beacon_url: str):
+        self.spec = spec
+        self.client = BeaconNodeHttpClient(beacon_url)
+        self.store = ValidatorStore(spec)
+        self._stop = threading.Event()
+        self._last_slot = -1
+        self._last_duties_epoch = -1
+
+    # -- key loading --------------------------------------------------------
+
+    def load_interop_keys(self, count: int) -> int:
+        for sk in interop_secret_keys(count):
+            self.store.add_validator_sk(
+                bls.SecretKey.from_bytes(sk.to_bytes(32, "big"))
+            )
+        return count
+
+    def load_keystore_dir(self, directory: str, password: str) -> int:
+        """EIP-2335 keystores named ``keystore-*.json`` (account_manager's
+        validator directory layout)."""
+        from ..keys.keystore import Keystore
+
+        n = 0
+        for name in sorted(os.listdir(directory)):
+            if not name.startswith("keystore") or not name.endswith(".json"):
+                continue
+            with open(os.path.join(directory, name)) as fh:
+                ks = Keystore.from_json(fh.read())
+            self.store.add_validator_keystore(ks, password)
+            n += 1
+        log.info("Loaded keystores", count=n, directory=directory)
+        return n
+
+    # -- duty loop ----------------------------------------------------------
+
+    def connect(self) -> "ProductionValidatorClient":
+        self.ctx = ValidatorClientContext(self.client, self.store)
+        self.duties = DutiesService(self.client, self.store)
+        self.attestations = AttestationService(self.ctx, self.duties)
+        self.blocks = BlockService(self.ctx, self.duties)
+        return self
+
+    def run_slot(self, slot: int) -> dict:
+        """One slot's duties: poll (per epoch), propose, attest."""
+        spe = self.spec.preset.SLOTS_PER_EPOCH
+        epoch = slot // spe
+        if epoch != self._last_duties_epoch:
+            self.duties.poll(epoch)
+            # poll one epoch ahead like the reference's lookahead
+            self.duties.poll(epoch + 1)
+            self._last_duties_epoch = epoch
+        proposed = self.blocks.propose(slot)
+        attested = self.attestations.attest(slot)
+        return {"slot": slot, "proposed": proposed, "attested": attested}
+
+    def run(self, genesis_time: int | None = None) -> None:
+        """Wall-clock duty loop until stop() (the tokio interval loop)."""
+        g = self.ctx.genesis
+        if genesis_time is None:
+            genesis_time = int(g.genesis_time)
+        sps = self.spec.preset.SECONDS_PER_SLOT
+        while not self._stop.is_set():
+            now = time.time()
+            slot = max(0, int(now - genesis_time) // sps)
+            if slot > self._last_slot:
+                self._last_slot = slot
+                try:
+                    stats = self.run_slot(slot)
+                    log.info("Slot duties", **stats)
+                except Exception as e:  # noqa: BLE001 — keep the loop alive
+                    log.error("Duty failure", slot=slot, error=str(e))
+            self._stop.wait(0.25)
+
+    def stop(self) -> None:
+        self._stop.set()

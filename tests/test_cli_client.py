@@ -1,0 +1,128 @@
+"""CLI + client assembly + observability (refs: lighthouse/src/main.rs,
+client/src/builder.rs, client/src/notifier.rs, http_metrics, account_manager).
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.cli import build_parser, run_account_manager, run_bn, run_vc
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.metrics import REGISTRY
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def test_parser_surface():
+    p = build_parser()
+    args = p.parse_args(
+        ["bn", "--preset", "minimal", "--metrics", "--slasher",
+         "--http-port", "0", "--metrics-port", "0"]
+    )
+    assert args.command == "bn" and args.slasher
+    args = p.parse_args(["vc", "--beacon-node", "http://x:1"])
+    assert args.beacon_node == "http://x:1"
+    args = p.parse_args(
+        ["account-manager", "--output-dir", "/tmp/x", "--password", "pw"]
+    )
+    assert args.count == 1
+
+
+def test_account_manager_roundtrip(tmp_path):
+    p = build_parser()
+    args = p.parse_args(
+        ["account-manager", "--output-dir", str(tmp_path), "--count", "2",
+         "--password", "testpw", "--mnemonic-seed", "ab" * 32]
+    )
+    written = run_account_manager(args)
+    assert len(written) == 2
+    from lighthouse_tpu.keys.keystore import Keystore
+
+    with open(tmp_path / written[0]) as fh:
+        ks = Keystore.from_json(fh.read())
+    sk = ks.decrypt("testpw")
+    assert len(sk) == 32
+    # deterministic across runs with the same seed
+    written2 = run_account_manager(args)
+    with open(tmp_path / written2[0]) as fh:
+        assert Keystore.from_json(fh.read()).decrypt("testpw") == sk
+
+
+def test_client_builder_full_node_with_vc_loop():
+    """CLI-shaped BN (http + metrics + slasher) driven by a CLI-shaped VC
+    through HTTP only — the `lighthouse bn` + `lighthouse vc` pair."""
+    spec = minimal_spec()
+    clock = ManualSlotClock(0)
+    cfg = ClientConfig(
+        metrics_enabled=True, slasher_enabled=True,
+        interop_validators=16, genesis_time=0, use_system_clock=False,
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock).build()
+    )
+    client.start()
+    try:
+        p = build_parser()
+        vargs = p.parse_args(
+            ["vc", "--preset", "minimal",
+             "--beacon-node", client.http_server.url,
+             "--interop-validators", "16"]
+        )
+        vc = run_vc(vargs)
+        for slot in range(1, 5):
+            clock.set_slot(slot)
+            stats = vc.run_slot(slot)
+            assert stats["proposed"], stats
+            assert stats["attested"] > 0
+        assert client.chain.head.slot == 4
+
+        # notifier status + metrics scrape
+        line = client.notifier.status_line()
+        assert line["head_slot"] == 4
+        body = urllib.request.urlopen(
+            client.metrics_server.url + "/metrics"
+        ).read().decode()
+        assert "beacon_block_processing_seconds" in body
+        assert "log_events_total" in body
+        health = json.load(
+            urllib.request.urlopen(client.metrics_server.url + "/health")
+        )
+        assert health["status"] == "ok"
+
+        # slasher service is subscribed to the chain's ingest seams and
+        # saw the imported blocks; a tick processes its queues
+        assert client.slasher_service.block_observed in client.chain.block_observers
+        assert (
+            client.slasher_service.attestation_observed
+            in client.chain.attestation_observers
+        )
+        client.slasher_service.tick(current_epoch=0)
+    finally:
+        client.stop()
+
+
+def test_bn_datadir_persistence(tmp_path):
+    """run_bn writes durable stores under --datadir."""
+    p = build_parser()
+    args = p.parse_args(
+        ["bn", "--preset", "minimal", "--datadir", str(tmp_path),
+         "--http-port", "0", "--interop-validators", "8",
+         "--genesis-time", "0"]
+    )
+    client = run_bn(args)
+    try:
+        assert (tmp_path / "chain.db").exists()
+        assert (tmp_path / "freezer.db").exists()
+    finally:
+        client.stop()
