@@ -20,16 +20,6 @@ from ..ops.bls_oracle.fields import P
 _P_LIMBS24 = np.array(
     [(P >> (16 * i)) & 0xFFFF for i in range(24)], dtype=np.uint64
 )
-_R2 = None  # lazy: R^2 mod p limbs (for to-Montgomery via one mont_mul)
-
-
-def _r2():
-    # cached as HOST numpy: a jnp array built during a jit trace would cache a
-    # tracer and leak it into later calls
-    global _R2
-    if _R2 is None:
-        _R2 = np.asarray(fq.int_to_limbs(fq.R_MONT * fq.R_MONT % P))
-    return _R2
 
 
 def _be_bytes_to_limbs(chunk: np.ndarray) -> np.ndarray:
@@ -108,10 +98,10 @@ def parse_g2_bytes(data: np.ndarray):
 
 
 def raw_to_mont(x):
-    """Raw-residue limbs -> Montgomery form on device (one mont_mul by R^2)."""
-    return fq.mont_mul(
-        jnp.asarray(x), jnp.broadcast_to(jnp.asarray(_r2()), np.shape(x))
-    )
+    """Raw-residue limbs -> field-element limbs. The field layer works on plain
+    residues (fq.py), so parsed canonical limbs ARE the element — no domain
+    conversion, no per-batch multiply. Name kept for call sites."""
+    return jnp.asarray(x)
 
 
 def _limbs_to_be_bytes(limbs: np.ndarray) -> np.ndarray:

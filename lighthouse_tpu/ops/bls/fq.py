@@ -1,28 +1,35 @@
 """Fq (BLS12-381 base field) arithmetic as JAX limb kernels.
 
-Representation: little-endian 16-bit limbs in uint64 lanes, **25 limbs** (R = 2^400
-Montgomery domain), shape ``[..., 25]``. The 25th limb buys ~19 bits of headroom over
-the 381-bit modulus, which enables the two properties the whole kernel stack is built
-on:
+Representation: little-endian 16-bit limbs in uint64 lanes, **25 limbs** (plain
+residues — no Montgomery domain), shape ``[..., 25]``. The 25th limb buys ~19 bits of
+headroom over the 381-bit modulus, which enables the properties the whole kernel
+stack is built on:
 
   * **Lazy addition/subtraction.** ``add``/``sub``/``neg`` are pure elementwise limb
     ops — no carry propagation, no comparison, ~2 HLO ops each. Limbs grow beyond 16
     bits and values beyond p; that's fine. The operand budget (enforced statically by
-    plans.lincomb) is: values < 600p and limbs < 2^22. Derivation: mont_mul needs
-    t = a*b < R*p, and 600p * 600p = 360000 p^2 < (2^400/p) * p^2 since
-    2^400/p > 2^18.7 > 360000; its REDC output is then t/R + p < 1.7p, made
-    canonical by one conditional subtract. The schoolbook convolution is exact for
-    limbs up to 2^22 (25 * 2^44 < 2^50 per uint64 accumulator). Convention: values
-    crossing a public tower-op boundary satisfy plans.PUB_BOUND (16-bit limbs,
-    value < 16p); lazy values live only between two Montgomery multiplies.
-    ``sub(a, b)``/``neg`` here require a *canonical* (< p) subtrahend: they add the
-    borrow-inflated constant 2p (every non-top limb rewritten >= 2^16 - 1). The
+    plans.lincomb) is: values < 1200p and limbs < 2^22. The schoolbook convolution is
+    exact for limbs up to 2^22 (25 * 2^44 < 2^50 per uint64 accumulator). Convention:
+    values crossing a public tower-op boundary satisfy plans.PUB_BOUND (16-bit limbs,
+    value < 16p); lazy values live only between two multiplies. ``sub(a, b)``/``neg``
+    here require a public-bounded subtrahend (any multiply output): they add a
+    borrow-inflated multiple of p whose limbs dominate the public bound. The
     tower layer (plans/tower) uses bound-tracked inflated constants instead.
 
-  * **One normalization point.** ``mont_mul`` is the only place carries propagate
-    (three lax.scan walks: REDC, carry, conditional subtract), and its output is
-    canonical. Tower ops stack all their independent multiplies into one mont_mul
-    call (see tower.py), so a full Fq12 multiply costs a single scan-compiled kernel.
+  * **Branchless congruence-fold reduction — no sequential REDC.** A 50-limb
+    convolution output is reduced by *folding*: limbs at positions >= 25 multiply a
+    precomputed constant matrix F[j] = limbs(2^(16(25+j)) mod p) and accumulate onto
+    the low limbs — one small matmul, a congruence mod p, no data-dependent carries.
+    Interleaved elementwise "carry rounds" (lo = t & mask; t = lo + shift(t >> 16))
+    keep limbs inside uint64 headroom. The only lax.scan left in the multiply path
+    is the trivial-body 16-bit carry walk; the serial 25-step Montgomery REDC (a
+    dynamic-update-slice scan that dominated both XLA compile time and VPU runtime)
+    is gone, and with it the Montgomery domain itself: values are plain residues,
+    so serialization and hashing skip domain conversion entirely.
+
+``mont_mul`` (name kept for call-site compatibility) returns a *public-bounded*
+value: < 13p, 16-bit limbs, top limb <= 2 (plans.PUB_BOUND). Equality, parity and
+serialization go through ``canonical()`` which finishes the reduction to < p.
 
 Correctness is pinned against ``lighthouse_tpu.ops.bls_oracle`` on random inputs.
 This layer is the TPU twin of the blst field backend the reference links against
@@ -44,9 +51,7 @@ NLIMBS = 25
 LIMB_BITS = 16
 MASK = np.uint64(0xFFFF)
 
-R_MONT = 1 << (NLIMBS * LIMB_BITS)          # 2^400
-R_INV_INT = pow(R_MONT, -1, P)
-N0_INT = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+R_MONT = 1  # plain-residue domain (no Montgomery factor; see module docstring)
 
 
 def int_to_limbs(x: int) -> np.ndarray:
@@ -62,46 +67,51 @@ def limbs_to_int(a) -> int:
     return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(a))
 
 
-def _inflated_2p() -> np.ndarray:
-    """Limbs of 2p rewritten so every limb except the top is >= 2^16 - 1, preserving
-    the value: c_0 stays, c_i (0<i<top) := c_i - 1 + 2^16, top := top - 1."""
-    c = [int(v) for v in int_to_limbs(2 * P)]
-    top = max(i for i, v in enumerate(c) if v)
-    for i in range(1, top + 1):
-        c[i - 1] += 1 << LIMB_BITS
-        c[i] -= 1
-    # re-add: above loop borrowed 1 from each c_i (1..top) into c_{i-1}
-    assert sum(v << (LIMB_BITS * i) for i, v in enumerate(c)) == 2 * P
-    assert all(v >= (1 << LIMB_BITS) - 1 for v in c[:top])
-    return np.array(c, dtype=np.uint64)
+def _inflated_kp(limb_cover: int, top_cover: int) -> np.ndarray:
+    """Limbs of the smallest K*p whose borrow-inflated representation has every
+    limb 0..23 >= limb_cover and limb 24 >= top_cover (so C - x never
+    underflows per limb for x within those bounds)."""
+    K = 1
+    while True:
+        c = [int(v) for v in int_to_limbs(K * P)]
+        assert (K * P).bit_length() <= NLIMBS * LIMB_BITS
+        for i in range(1, NLIMBS):
+            c[i - 1] += 1 << LIMB_BITS
+            c[i] -= 1
+        if (
+            all(v >= 0 for v in c)
+            and all(c[i] >= limb_cover for i in range(24))
+            and c[24] >= top_cover
+        ):
+            assert sum(v << (LIMB_BITS * i) for i, v in enumerate(c)) == K * P
+            return np.array(c, dtype=np.uint64)
+        K += 1
 
 
 P_LIMBS = jnp.asarray(int_to_limbs(P))
-SUB2P = jnp.asarray(_inflated_2p())
-N0 = jnp.uint64(N0_INT)
-ONE_M = jnp.asarray(int_to_limbs(R_MONT % P))
+# Covers any plans.PUB_BOUND subtrahend (16-bit limbs, top limb <= 2) — in
+# particular every multiply output.
+SUBPUB = jnp.asarray(_inflated_kp((1 << LIMB_BITS) - 1, 2))
+SUB2P = SUBPUB  # historical name
+ONE_M = jnp.asarray(int_to_limbs(1))  # multiplicative identity (plain domain)
 ONE_RAW = jnp.zeros((NLIMBS,), dtype=jnp.uint64).at[0].set(1)
 
 
 def from_int(x: int, mont: bool = True):
-    """Host int -> device limbs (Montgomery form by default); conversion happens
-    host-side with Python bignums."""
-    x %= P
-    return jnp.asarray(int_to_limbs(x * R_MONT % P if mont else x))
+    """Host int -> device limbs. The domain is plain residues, so the ``mont``
+    flag (kept for call-site compatibility) is a no-op."""
+    return jnp.asarray(int_to_limbs(x % P))
 
 
 def from_ints(xs, mont: bool = True):
     """Batch host conversion: list of ints -> uint64[len(xs), 25]."""
-    return jnp.asarray(
-        np.stack([int_to_limbs(x % P * (R_MONT if mont else 1) % P) for x in xs])
-    )
+    return jnp.asarray(np.stack([int_to_limbs(x % P) for x in xs]))
 
 
 def to_int(a, mont: bool = True) -> int:
-    """Device limbs -> Python int (out of Montgomery form by default). Accepts lazy
-    (non-canonical) values."""
-    v = limbs_to_int(np.asarray(a)) % P
-    return v * R_INV_INT % P if mont else v
+    """Device limbs -> Python int. Accepts lazy (non-canonical) values; the
+    ``mont`` flag is a no-op (plain domain)."""
+    return limbs_to_int(np.asarray(a)) % P
 
 
 def to_ints(a, mont: bool = True) -> list:
@@ -118,13 +128,14 @@ def add(a, b):
 
 
 def sub(a, b):
-    """a - b + 2p. b must be canonical (16-bit limbs); a may be lazy."""
-    return a + (SUB2P - b)
+    """a - b + Kp. b must be public-bounded (16-bit limbs, top <= 2 — any
+    multiply output or canonical value); a may be lazy."""
+    return a + (SUBPUB - b)
 
 
 def neg(a):
-    """2p - a. a must be canonical."""
-    return SUB2P - a
+    """Kp - a. a must be public-bounded."""
+    return SUBPUB - a
 
 
 def double(a):
@@ -149,7 +160,7 @@ def select(cond, a, b):
 
 
 # --------------------------------------------------------------------------------------
-# Montgomery multiplication — the single normalization point
+# Multiplication: convolution + congruence-fold reduction (no sequential REDC)
 # --------------------------------------------------------------------------------------
 
 def _carry_propagate(t, out_limbs: int):
@@ -197,41 +208,207 @@ def _conv_product(a, b):
     return sum(rows)  # [..., 50]
 
 
+# Congruence-fold rows: _FOLD_ROWS[j] = 16-bit limbs of 2^(16*(25+j)) mod p.
+# Folding limb 25+j through its row is an exact congruence mod p.
+_N_FOLD = 40
+_FOLD_NP = np.stack(
+    [int_to_limbs((1 << (LIMB_BITS * (NLIMBS + j))) % P) for j in range(_N_FOLD)]
+)
+_FOLD_ROWS = jnp.asarray(_FOLD_NP)
+_FOLD_VALS = [(1 << (LIMB_BITS * (NLIMBS + j))) % P for j in range(_N_FOLD)]
+
+PUB_VALUE_LIMIT = 13 * P  # reduce() output value bound (plans.PUB_BOUND holds)
+
+
+class _RState:
+    """Exact static bound state for reduce_limbs(): per-limb bounds (Python
+    ints) plus a value bound, mutually refined — any limb t_i <= value >> 16i
+    since limbs are non-negative. Every transform updates the state exactly, so
+    uint64 overflow and carry-drop safety are proved at trace time."""
+
+    __slots__ = ("limbs", "value")
+
+    def __init__(self, limbs, value):
+        limbs = list(limbs)
+        value = min(
+            value, sum(b << (LIMB_BITS * i) for i, b in enumerate(limbs))
+        )
+        self.limbs = [min(b, value >> (LIMB_BITS * i)) for i, b in enumerate(limbs)]
+        self.value = value
+
+
+def _carry_round_array(t):
+    """One elementwise carry-save round (appends a limb; value unchanged)."""
+    lo = t & MASK
+    hi = t >> np.uint64(LIMB_BITS)
+    nb = [(0, 0)] * (t.ndim - 1)
+    return jnp.pad(lo, nb + [(0, 1)]) + jnp.pad(hi, nb + [(1, 0)])
+
+
+def _carry_round(t, s: _RState):
+    t = _carry_round_array(t)
+    lo_b = [min(b, int(MASK)) for b in s.limbs] + [0]
+    hi_b = [0] + [b >> LIMB_BITS for b in s.limbs]
+    return t, _RState([a + b for a, b in zip(lo_b, hi_b)], s.value)
+
+
+def _fold_high(t, s: _RState):
+    """Fold limbs >= 25 through the 2^(16k) mod p rows — an exact congruence
+    mod p that shrinks the value by ~2^19x per live high limb."""
+    n_hi = t.shape[-1] - NLIMBS
+    lo, hi = t[..., :NLIMBS], t[..., NLIMBS:]
+    t = lo + (hi[..., :, None] * _FOLD_ROWS[:n_hi]).sum(-2)
+    lo_b, hi_b = s.limbs[:NLIMBS], s.limbs[NLIMBS:]
+    limbs = [
+        b + sum(hb * int(_FOLD_NP[j, i]) for j, hb in enumerate(hi_b))
+        for i, b in enumerate(lo_b)
+    ]
+    assert max(limbs) < 1 << 64, "fold accumulator overflow"
+    lo_val = sum(b << (LIMB_BITS * i) for i, b in enumerate(lo_b))
+    value = min(s.value, lo_val) + sum(
+        hb * _FOLD_VALS[j] for j, hb in enumerate(hi_b)
+    )
+    return t, _RState(limbs, value)
+
+
+_RT384_VAL = (1 << 384) % P
+_RT384_NP = int_to_limbs(_RT384_VAL)
+_RT384_ROW = jnp.asarray(_RT384_NP)
+_RT381_VAL = (1 << 381) % P
+_RT381_ROW = jnp.asarray(int_to_limbs(_RT381_VAL))
+
+
+def _fold_384(t, s: _RState):
+    """Fold the 2^384-and-up excess of a 25-limb array through 2^384 mod p."""
+    top = t[..., 24]
+    t = t.at[..., 24].set(0) + top[..., None] * _RT384_ROW
+    top_b = s.limbs[24]
+    limbs = [
+        b + top_b * int(_RT384_NP[i]) for i, b in enumerate(s.limbs[:24])
+    ] + [top_b * int(_RT384_NP[24])]
+    assert max(limbs) < 1 << 64, "fold384 accumulator overflow"
+    lo_val = sum(b << (LIMB_BITS * i) for i, b in enumerate(s.limbs[:24]))
+    return t, _RState(limbs, min(s.value, lo_val) + top_b * _RT384_VAL)
+
+
+def _propagate_exact(t, s: _RState, n_out: int):
+    """Exact 16-bit carry walk over n_out limbs (one of the only two lax.scans
+    in the multiply path). Asserts the value fits n_out limbs."""
+    assert s.value < 1 << (LIMB_BITS * n_out), "carry-propagate would drop value"
+    if t.shape[-1] < n_out:
+        t = jnp.pad(t, [(0, 0)] * (t.ndim - 1) + [(0, n_out - t.shape[-1])])
+    t = _carry_propagate(t, n_out)
+    return t, _RState([int(MASK)] * n_out, s.value)
+
+
+def _drop_zero_tops(t, s: _RState):
+    while t.shape[-1] > NLIMBS and s.limbs[t.shape[-1] - 1] == 0:
+        t = t[..., : t.shape[-1] - 1]
+        s = _RState(s.limbs[: t.shape[-1]], s.value)
+    return t, s
+
+
+def reduce_limbs(t, limb_bounds, value_bound: int):
+    """Reduce [..., N] (N >= 25) to plans.PUB_BOUND: value < 13p, 16-bit limbs,
+    top limb <= 2. Statically scheduled congruence folds + elementwise carry
+    rounds with exactly TWO trivial-body scans; bounds proved at trace time."""
+    s = _RState(list(limb_bounds), value_bound)
+    # phase 1: fold down to 25 limbs
+    for _ in range(64):
+        t, s = _drop_zero_tops(t, s)
+        if t.shape[-1] == NLIMBS:
+            break
+        n_hi = t.shape[-1] - NLIMBS
+        prod = max(s.limbs[:NLIMBS]) + sum(
+            hb * int(MASK) for hb in s.limbs[NLIMBS:]
+        )
+        if n_hi <= _N_FOLD and prod < 1 << 64:
+            t, s = _fold_high(t, s)
+        else:
+            t, s = _carry_round(t, s)
+    else:  # pragma: no cover - static schedule
+        raise AssertionError("reduce_limbs: phase 1 did not converge")
+    # phase 2: one exact walk, wide enough that no carry is dropped
+    n_out = max(NLIMBS + 1, -(-s.value.bit_length() // LIMB_BITS) + 1)
+    t, s = _propagate_exact(t, s, n_out)
+    # phase 3: drain high limbs and the 2^384 excess — all elementwise
+    for _ in range(64):
+        t, s = _drop_zero_tops(t, s)
+        if t.shape[-1] > NLIMBS:
+            prod = max(s.limbs[:NLIMBS]) + sum(
+                hb * int(MASK) for hb in s.limbs[NLIMBS:]
+            )
+            if prod < 1 << 64:
+                t, s = _fold_high(t, s)
+            else:
+                t, s = _carry_round(t, s)
+        elif s.value > PUB_VALUE_LIMIT:
+            # fold only when it provably shrinks the value (the excess may sit
+            # in low limbs after a previous fold — surface it with a carry)
+            lo_val = sum(
+                b << (LIMB_BITS * i) for i, b in enumerate(s.limbs[:24])
+            )
+            predicted = min(s.value, lo_val) + s.limbs[24] * _RT384_VAL
+            safe = s.limbs[24] * int(MASK) + max(s.limbs[:24]) < 1 << 64
+            if safe and predicted < s.value:
+                t, s = _fold_384(t, s)
+            else:
+                t, s = _carry_round(t, s)
+        else:
+            break
+    else:  # pragma: no cover - static schedule
+        raise AssertionError("reduce_limbs: phase 3 did not converge")
+    # phase 4: final exact walk to 16-bit limbs (top <= 2 since value < 13p)
+    t, s = _propagate_exact(t, s, NLIMBS)
+    assert s.value <= PUB_VALUE_LIMIT
+    return t
+
+
+# Conv-input budget (the plans.lincomb contract): limbs < 2^22, value < 1200p.
+_IN_LIMB = (1 << 22) - 1
+_IN_VALUE = 1200 * P
+
+
+def _conv_limb_bounds(lb: int):
+    return [max(1, min(i + 1, NLIMBS, 49 - i)) * lb * lb for i in range(2 * NLIMBS)]
+
+
 def mont_mul(a, b):
-    """Montgomery product a*b*R^-1 mod p; canonical output. Operand values may be
-    lazy up to 600p with limbs up to 2^22 (see module docstring)."""
+    """Product a*b mod p (plain domain — the historical name is kept for the
+    call sites). Operands may be lazy up to _IN_VALUE (1200p) with limbs up to
+    _IN_LIMB (2^22); output satisfies plans.PUB_BOUND (< 13p, 16-bit limbs,
+    top <= 2)."""
     t = _conv_product(a, b)
-    t = jnp.moveaxis(t, -1, 0)  # [50, ...]
-    p_tail = P_LIMBS[1:].reshape((NLIMBS - 1,) + (1,) * (t.ndim - 1))
-
-    def step(carry, _):
-        buf, c = carry
-        ti = buf[0] + c
-        m = (ti * N0) & MASK
-        buf = buf.at[1:NLIMBS].add(m[None] * p_tail)
-        c = (ti + m * P_LIMBS[0]) >> np.uint64(LIMB_BITS)
-        buf = jnp.concatenate([buf[1:], jnp.zeros_like(buf[:1])], axis=0)
-        return (buf, c), None
-
-    (t, c), _ = jax.lax.scan(step, (t, jnp.zeros_like(t[0])), None, length=NLIMBS)
-    res = jnp.moveaxis(t[:NLIMBS], 0, -1)
-    res = res.at[..., 0].add(c)
-    res = _carry_propagate(res, NLIMBS)  # value < 1.7p at the full operand budget
-    return _cond_sub_p(res)
+    return reduce_limbs(t, _conv_limb_bounds(_IN_LIMB), _IN_VALUE * _IN_VALUE)
 
 
 def mont_sqr(a):
     return mont_mul(a, a)
 
 
+def canonical(a):
+    """Fully reduce to the canonical residue < p (comparisons, parity,
+    serialization). Accepts anything within the lazy budget."""
+    t = reduce_limbs(a, [_IN_LIMB] * a.shape[-1], _IN_VALUE)
+    # value < 13p: two sub-limb folds at the 2^381 boundary bring it under 2p
+    for _ in range(2):
+        hi = (t[..., 23] >> np.uint64(13)) + (t[..., 24] << np.uint64(3))
+        t = (
+            t.at[..., 23].set(t[..., 23] & np.uint64(0x1FFF)).at[..., 24].set(0)
+            + hi[..., None] * _RT381_ROW
+        )
+        t = _carry_propagate(t, NLIMBS)
+    return _cond_sub_p(t)
+
+
 def normalize(a):
-    """Lazy -> canonical without changing the Montgomery factor: a * R * R^-1."""
-    return mont_mul(a, jnp.broadcast_to(ONE_M, a.shape))
+    """Lazy -> canonical (< p), value unchanged mod p."""
+    return canonical(a)
 
 
 def from_mont(a):
-    """Montgomery -> canonical plain residue: a * 1 * R^-1."""
-    return mont_mul(a, jnp.broadcast_to(ONE_RAW, a.shape))
+    """Canonical plain residue (the domain IS plain; name kept for callers)."""
+    return canonical(a)
 
 
 # --------------------------------------------------------------------------------------

@@ -109,7 +109,7 @@ def decompress(x_mont, s_flag):
         fq.mont_mul(fq.mont_mul(x, x), x) + tower.one(1, x.shape[:-2]) * np.uint64(4)
     )
     y = fq.sqrt_candidate(x3b[..., 0, :])
-    ok = fq.eq(fq.mont_mul(y, y), fq.normalize(x3b[..., 0, :]))
+    ok = fq.eq(fq.canonical(fq.mont_mul(y, y)), fq.normalize(x3b[..., 0, :]))
     big = fq.lex_gt_half(y)
     y = plans.carry_norm(fq.select(big ^ (s_flag == 1), fq.neg(y), y))
     return curve.from_affine(K, x, y[..., None, :]), ok

@@ -1,25 +1,30 @@
-"""Lane-plan compiler: flattens tower algebra into lincomb -> mont_mul -> lincomb.
+"""Lane-plan compiler: flattens tower algebra into lincomb -> conv -> lincomb -> fold.
 
 A multiplication in Fq2/Fq6/Fq12 is a bilinear map. Karatsuba decomposes it into L
 independent base-field products whose operands are small integer linear combinations
 of the input coefficients, and whose outputs recombine linearly. This module derives
 those linear maps **symbolically at import time** and materializes a tower op as:
 
-    A = lincomb(a)          # [..., L, 25]   (flat adds/subs, no carries)
+    A = lincomb(a)            # [..., L, 25]   (flat adds/subs, no carries)
     B = lincomb(b)
-    T = fq.mont_mul(A, B)   # ONE stacked Montgomery kernel for all L lanes
-    out = lincomb(T)        # [..., k, 25]
-    out = carry_norm(out)   # one scan: 16-bit limbs, value still lazy (< ~16p)
+    T = fq._conv_product(A,B) # [..., L, 50]   unreduced accumulators
+    out = wide-lincomb(T)     # [..., k, 51]   output map on UNREDUCED limbs
+    out = fq.reduce_limbs(out)# congruence-fold reduction, ONE per output row
 
-Why: emitting each base-field multiply as its own XLA op cost ~1s of compile *per
-instance* (an Fq12 multiply has 54), and a kernel launch each at runtime. One wide
-kernel compiles once and feeds the VPU a [54 * batch]-lane workload.
+The output linear maps commute with modular reduction, so recombination happens on
+the raw convolution accumulators and only the k output rows are reduced — an Fq12
+multiply reduces 12 rows, not its 54 Karatsuba lanes. Reduction itself is the
+fold pipeline in fq.py (no sequential Montgomery REDC, two trivial carry scans).
+
+Why one wide kernel: emitting each base-field multiply as its own XLA op cost ~1s
+of compile *per instance*; one stacked kernel compiles once and feeds the VPU a
+[L * batch]-lane workload.
 
 Subtraction never goes negative: a - b is computed as a + (C - b) where C is a
 borrow-inflated multiple of p (every limb of C >= the static per-limb bound of b).
 Static bounds (value in units of p, per-limb magnitude) are tracked through every
-linear combination and asserted against the Montgomery operand budget
-(value < 600p, limbs < 2^22 — see fq.py docstring) at plan-build time.
+linear combination and asserted against the lazy operand budget
+(value < 1200p, limbs < 2^22 — see fq.py docstring) at plan-build time.
 
 Element layout (little-endian coefficient order, flat over the tower):
     fq2  = [..., 2, 25]   (c0, c1)
@@ -44,7 +49,7 @@ PUB_VALUE_P = 16          # public elements have value < 16 p
 PUB_LIMB = (1 << 16) - 1  # ... and 16-bit limbs (limbs 0..23)
 PUB_TOP_LIMB = 2          # ... limb 24 <= 2 (guaranteed by carry_norm's double fold)
 
-MAX_VALUE_P = 600         # Montgomery operand budget (see fq.py)
+MAX_VALUE_P = 1200        # lazy operand budget (must match fq._IN_VALUE)
 MAX_LIMB = 1 << 22
 
 
@@ -332,32 +337,90 @@ def carry_norm(x):
     return fq._carry_propagate(x, fq.NLIMBS)
 
 
+_SUBC_WIDE_CACHE: dict[tuple[int, int], np.ndarray] = {}
+
+
+def _subc_wide(n_limbs: int, cover: int) -> np.ndarray:
+    """A constant == 0 mod p in n_limbs-limb space with every limb >= cover
+    (subtraction cover for unreduced convolution accumulators)."""
+    key = (n_limbs, cover)
+    if key not in _SUBC_WIDE_CACHE:
+        c = [cover] * n_limbs
+        adj = (-sum(v << (16 * i) for i, v in enumerate(c))) % P
+        for i in range(fq.NLIMBS):
+            c[i] += (adj >> (16 * i)) & 0xFFFF
+        assert sum(v << (16 * i) for i, v in enumerate(c)) % P == 0
+        assert max(c) < 1 << 63
+        _SUBC_WIDE_CACHE[key] = np.array(c, dtype=np.uint64)
+    return _SUBC_WIDE_CACHE[key]
+
+
 def execute(plan: Plan, a, b, in_bound_a=PUB_BOUND, in_bound_b=PUB_BOUND, name=""):
-    """Run a plan: returns [..., n_out, 25] public-bounded output."""
-    A, _ = lincomb(plan.a_rows, a, in_bound_a, name + ".A")
+    """Run a plan: returns [..., n_out, 25] public-bounded output.
+
+    The output linear maps commute with reduction, so they run on the
+    *unreduced* convolution accumulators: conv -> out-lincomb (wide limbs) ->
+    ONE congruence-fold reduction per OUTPUT row. An Fq12 multiply reduces 12
+    rows instead of its 54 Karatsuba lanes, and the fold reduction already
+    lands on plans.PUB_BOUND — no trailing carry_norm."""
+    A, ba = lincomb(plan.a_rows, a, in_bound_a, name + ".A")
     if plan.consts:
         cpool = jnp.asarray(
             np.stack([fq.int_to_limbs(c) for c in plan.consts])
         )
         cpool = jnp.broadcast_to(cpool, b.shape[:-2] + cpool.shape)
         b = jnp.concatenate([b, cpool], axis=-2)
-    B, _ = lincomb(plan.b_rows, b, in_bound_b, name + ".B")
-    T = fq.mont_mul(A, B)
+    B, bb = lincomb(plan.b_rows, b, in_bound_b, name + ".B")
+    T = fq._conv_product(A, B)  # [..., L, 50] unreduced accumulators
+    # one elementwise carry round caps limbs (~2^33) so out-row accumulation
+    # and subtraction covers stay inside uint64
+    conv_limb = 25 * ba.limb * bb.limb
+    assert conv_limb < 1 << 63, f"{name}: conv accumulator overflow"
+    lane_limb = (1 << 16) + (conv_limb >> 16)
+    T = fq._carry_round_array(T)  # [..., L, 51]
+    n_wide = T.shape[-1]
     L = len(plan.a_rows)
-    if any(i < 0 for lc in plan.out_rows for i in lc.d):
-        # out rows reference inputs (pass-through): append `a` after the lanes
-        T = jnp.concatenate([T, a], axis=-2)
+    has_passthrough = any(i < 0 for lc in plan.out_rows for i in lc.d)
+    if has_passthrough:
+        # pass-through rows reference `a`: zero-pad it into the wide space
+        pad = [(0, 0)] * (a.ndim - 1) + [(0, n_wide - a.shape[-1])]
+        T = jnp.concatenate([T, jnp.pad(a, pad)], axis=-2)
         out_rows = [
             LC({(i if i >= 0 else L - 1 - i): c for i, c in lc.d.items()})
             for lc in plan.out_rows
         ]
-        out, _ = lincomb(
-            out_rows, T, CANON_BOUND, name + ".out",
-            bound_for=lambda i: CANON_BOUND if i < L else in_bound_a,
-        )
     else:
-        out, _ = lincomb(plan.out_rows, T, CANON_BOUND, name + ".out")
-    return carry_norm(out)
+        out_rows = plan.out_rows
+    outs = []
+    worst_limb = 0
+    for lc in out_rows:
+        pos = None
+        neg = None
+        limb = n_limb = 0
+        for idx, c in sorted(lc.d.items()):
+            lb = lane_limb if idx < L else in_bound_a.limb
+            mag = abs(c)
+            term = T[..., idx, :]
+            if mag != 1:
+                term = term * np.uint64(mag)
+            if c > 0:
+                pos = term if pos is None else pos + term
+                limb += mag * lb
+            else:
+                neg = term if neg is None else neg + term
+                n_limb += mag * lb
+        if neg is not None:
+            subc = _subc_wide(n_wide, n_limb)
+            pos = (jnp.asarray(subc) - neg) + (0 if pos is None else pos)
+            limb += int(subc.max())
+        elif pos is None:
+            pos = jnp.zeros_like(T[..., 0, :])
+        assert limb < 1 << 63, f"{name}: wide accumulator bound 2^{limb.bit_length()}"
+        worst_limb = max(worst_limb, limb)
+        outs.append(pos)
+    out = jnp.stack(outs, axis=-2)
+    value_bound = sum(worst_limb << (16 * i) for i in range(n_wide))
+    return fq.reduce_limbs(out, [worst_limb] * n_wide, value_bound)
 
 
 # --------------------------------------------------------------------------------------
