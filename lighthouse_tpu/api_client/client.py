@@ -171,3 +171,6 @@ class BeaconNodeHttpClient:
     def get_head_header(self):
         d = self._get("/eth/v1/beacon/headers/head")["data"]
         return {"root": _unhex(d["root"]), "slot": int(d["header"]["slot"])}
+
+    def get_validator_liveness(self, epoch: int, indices: list[int]):
+        return self._post(f"/eth/v1/validator/liveness/{epoch}", indices)["data"]

@@ -133,8 +133,35 @@ class BeaconChain:
         # hot path.
         self.block_observers: list = []
         self.attestation_observers: list = []
+        # Liveness tracking for doppelganger protection (the reference's
+        # ObservedAttesters / ObservedBlockProducers caches feeding
+        # /eth/v1/validator/liveness): epoch -> validator indices seen
+        # attesting or proposing. Pruned to the last few epochs.
+        self._observed_attesters: dict[int, set[int]] = {}
+        self._observed_proposers: dict[int, set[int]] = {}
+
+    def _record_liveness(self, table: dict, epoch: int, indices) -> None:
+        s = table.setdefault(epoch, set())
+        s.update(int(i) for i in indices)
+        for old in [e for e in table if e < epoch - 4]:
+            del table[old]
+
+    def validator_liveness(self, epoch: int, indices) -> list[bool]:
+        """Was each validator index observed attesting or proposing in
+        ``epoch``? (http_api liveness endpoint, consumed by the VC's
+        doppelganger service.)"""
+        seen = self._observed_attesters.get(epoch, set()) | (
+            self._observed_proposers.get(epoch, set())
+        )
+        return [int(i) in seen for i in indices]
 
     def _notify_block_observers(self, signed_block) -> None:
+        blk = signed_block.message
+        self._record_liveness(
+            self._observed_proposers,
+            self.spec.compute_epoch_at_slot(int(blk.slot)),
+            [int(blk.proposer_index)],
+        )
         for obs in self.block_observers:
             try:
                 obs(signed_block)
@@ -142,6 +169,11 @@ class BeaconChain:
                 pass
 
     def _notify_attestation_observers(self, indexed) -> None:
+        self._record_liveness(
+            self._observed_attesters,
+            int(indexed.data.target.epoch),
+            indexed.attesting_indices,
+        )
         for obs in self.attestation_observers:
             try:
                 obs(indexed)

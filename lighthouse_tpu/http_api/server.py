@@ -344,6 +344,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/eth/v1/beacon/blocks$"), "publish_block"),
     ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_atts"),
     ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
+    ("POST", re.compile(r"^/eth/v1/validator/liveness/(\d+)$"), "liveness"),
 ]
 
 # Routes that mutate chain state and therefore serialize on the chain's
@@ -436,6 +437,14 @@ def _make_handler(api: BeaconApiServer):
                 return api.publish_attestations(self._body())
             if name == "header":
                 return api.get_header()
+            if name == "liveness":
+                epoch = int(match.group(1))
+                indices = [int(x) for x in self._body()]
+                live = api.chain.validator_liveness(epoch, indices)
+                return [
+                    {"index": str(i), "is_live": bool(l)}
+                    for i, l in zip(indices, live)
+                ]
             raise ApiError(500, f"unwired route {name}")
 
         def do_GET(self):

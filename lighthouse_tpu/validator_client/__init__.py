@@ -1,4 +1,8 @@
 """Validator client (validator_client/* twin): duties-driven signer."""
 
-from .slashing_protection import SlashingDatabase, NotSafe
+from .beacon_node_fallback import AllErrored, BeaconNodeFallback, Health
+from .doppelganger import DoppelgangerService
+from .keymanager import KeymanagerServer
+from .slashing_protection import NotSafe, SlashingDatabase
 from .validator_store import ValidatorStore
+from .web3signer import MockWeb3Signer, Web3SignerMethod

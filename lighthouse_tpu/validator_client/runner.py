@@ -29,10 +29,30 @@ log = get_logger("validator_client")
 
 
 class ProductionValidatorClient:
-    def __init__(self, spec, beacon_url: str):
+    def __init__(self, spec, beacon_url, enable_doppelganger: bool = False,
+                 keymanager_port: int | None = None):
+        from .beacon_node_fallback import BeaconNodeFallback
+
         self.spec = spec
-        self.client = BeaconNodeHttpClient(beacon_url)
+        urls = (
+            [u.strip() for u in beacon_url.split(",") if u.strip()]
+            if isinstance(beacon_url, str)
+            else list(beacon_url)
+        )
+        # single node still goes through the fallback shell so health scoring
+        # and retry semantics are uniform (beacon_node_fallback.rs)
+        self.client = BeaconNodeFallback(urls)
         self.store = ValidatorStore(spec)
+        self.doppelganger = None
+        if enable_doppelganger:
+            from .doppelganger import DoppelgangerService
+
+            self.doppelganger = DoppelgangerService(self.store, self.client)
+        self.keymanager = None
+        if keymanager_port is not None:
+            from .keymanager import KeymanagerServer
+
+            self.keymanager = KeymanagerServer(self.store, port=keymanager_port)
         self._stop = threading.Event()
         self._last_slot = -1
         self._last_duties_epoch = -1
@@ -45,6 +65,30 @@ class ProductionValidatorClient:
                 bls.SecretKey.from_bytes(sk.to_bytes(32, "big"))
             )
         return count
+
+    def load_web3signer(self, signer_url: str) -> int:
+        """Register every key the remote signer serves
+        (/api/v1/eth2/publicKeys — Web3Signer's key-listing endpoint).
+        An unreachable signer is a startup error, not a silent zero-key run;
+        individual keys can also be registered later via the keymanager
+        remotekeys API."""
+        import json
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                signer_url.rstrip("/") + "/api/v1/eth2/publicKeys", timeout=10
+            ) as resp:
+                pubkeys = json.loads(resp.read().decode())
+        except Exception as e:
+            log.error("Web3Signer unreachable", signer=signer_url, error=str(e))
+            raise RuntimeError(
+                f"web3signer key listing failed at {signer_url}: {e}"
+            ) from None
+        for p in pubkeys:
+            self.store.add_validator_remote(bytes.fromhex(p[2:]), signer_url)
+        log.info("Registered remote keys", count=len(pubkeys), signer=signer_url)
+        return len(pubkeys)
 
     def load_keystore_dir(self, directory: str, password: str) -> int:
         """EIP-2335 keystores named ``keystore-*.json`` (account_manager's
@@ -69,16 +113,32 @@ class ProductionValidatorClient:
         self.duties = DutiesService(self.client, self.store)
         self.attestations = AttestationService(self.ctx, self.duties)
         self.blocks = BlockService(self.ctx, self.duties)
+        g = self.ctx.genesis
+        self.client.pin_genesis(g.genesis_validators_root)
+        self.client.update_all_candidates()
+        if self.keymanager is not None:
+            self.keymanager.start()
         return self
 
     def run_slot(self, slot: int) -> dict:
-        """One slot's duties: poll (per epoch), propose, attest."""
+        """One slot's duties: poll (per epoch), doppelganger gate, propose,
+        attest."""
         spe = self.spec.preset.SLOTS_PER_EPOCH
         epoch = slot // spe
         if epoch != self._last_duties_epoch:
+            # re-score the fallback candidates once per epoch (the
+            # reference's periodic health poll)
+            self.client.update_all_candidates()
             self.duties.poll(epoch)
             # poll one epoch ahead like the reference's lookahead
             self.duties.poll(epoch + 1)
+            if self.doppelganger is not None:
+                if self._last_duties_epoch < 0:
+                    self.doppelganger.register_all(epoch)
+                else:
+                    self.doppelganger.check(
+                        epoch, self.duties.validator_indices()
+                    )
             self._last_duties_epoch = epoch
         proposed = self.blocks.propose(slot)
         attested = self.attestations.attest(slot)

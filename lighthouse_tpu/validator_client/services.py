@@ -16,6 +16,7 @@ import numpy as np
 from ..api_client import BeaconNodeHttpClient
 from ..api_client.client import AttesterDuty, ProposerDuty
 from ..types.containers import AttestationData, Fork, for_preset
+from .slashing_protection import NotSafe
 from .validator_store import ValidatorStore
 
 
@@ -113,14 +114,22 @@ class AttestationService:
             data = AttestationData.decode(
                 self.ctx.client.get_attestation_data(slot, duty.committee_index)
             )
-            sig = self.ctx.store.sign_attestation(duty.pubkey, data, fork_info)
+            try:
+                sig = self.ctx.store.sign_attestation(
+                    duty.pubkey, data, fork_info
+                )
+            except NotSafe:
+                # held back (doppelganger) or slashing-protected — skip this
+                # validator, keep attesting with the rest
+                continue
             bits = np.zeros(duty.committee_length, dtype=bool)
             bits[duty.validator_committee_index] = True
             att = ns.Attestation(
                 aggregation_bits=bits, data=data, signature=sig.serialize()
             )
             published.append(ns.Attestation.encode(att))
-        self.ctx.client.publish_attestations(published)
+        if published:
+            self.ctx.client.publish_attestations(published)
         return len(published)
 
 
@@ -140,7 +149,10 @@ class BlockService:
             return False
         duty = my[0]
         fork_info = self.ctx.fork_info()
-        randao = self.ctx.store.sign_randao(duty.pubkey, epoch, fork_info)
+        try:
+            randao = self.ctx.store.sign_randao(duty.pubkey, epoch, fork_info)
+        except NotSafe:
+            return False  # held back (doppelganger) — skip the proposal
         version, block_ssz = self.ctx.client.produce_block(
             slot, randao.serialize()
         )

@@ -59,6 +59,23 @@ class ValidatorStore:
         secret = keystore.decrypt(password)
         return self.add_validator_sk(bls.SecretKey.from_bytes(secret))
 
+    def add_validator_remote(self, pubkey: bytes, signer_url: str) -> bytes:
+        """Register a Web3Signer-backed validator (remote key; local slashing
+        protection still gates every signature)."""
+        from .web3signer import Web3SignerMethod
+
+        pk = bytes(pubkey)
+        self.validators[pk] = InitializedValidator(
+            pk, Web3SignerMethod(pk, signer_url)
+        )
+        self.slashing_db.register_validator(pk)
+        return pk
+
+    def remove_validator(self, pubkey: bytes) -> bool:
+        """Delete a key from the store (keymanager DELETE). The slashing
+        history stays in the database — it must survive key round-trips."""
+        return self.validators.pop(bytes(pubkey), None) is not None
+
     def voting_pubkeys(self) -> list[bytes]:
         return [pk for pk, v in self.validators.items() if v.enabled]
 

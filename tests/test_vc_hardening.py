@@ -1,0 +1,208 @@
+"""VC hardening: beacon-node fallback, doppelganger, web3signer, keymanager.
+
+Refs: validator_client/beacon_node_fallback (failover), doppelganger_service
+(liveness hold-back), signing_method/src/web3signer.rs (remote signing),
+validator_client/http_api (keymanager CRUD).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.state_transition.genesis import interop_secret_keys
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client import (
+    AllErrored,
+    BeaconNodeFallback,
+    DoppelgangerService,
+    Health,
+    KeymanagerServer,
+    MockWeb3Signer,
+    ValidatorStore,
+)
+from lighthouse_tpu.validator_client.runner import ProductionValidatorClient
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def bn():
+    spec = minimal_spec()
+    clock = ManualSlotClock(0)
+    cfg = ClientConfig(
+        interop_validators=16, genesis_time=0, use_system_clock=False
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock).build()
+    )
+    client.start()
+    client._clock = clock
+    yield client
+    client.stop()
+
+
+def _sks(n):
+    return [
+        bls.SecretKey.from_bytes(x.to_bytes(32, "big"))
+        for x in interop_secret_keys(n)
+    ]
+
+
+def test_fallback_routes_around_dead_node(bn):
+    fb = BeaconNodeFallback(
+        ["http://127.0.0.1:1", bn.http_server.url]  # first node dead
+    )
+    g = fb.get_genesis()  # dispatches through first_success
+    assert g.genesis_time == 0
+    # the dead candidate was demoted to Offline
+    assert fb.candidates[0].health is Health.Offline
+    fb.update_all_candidates()
+    assert fb.num_available() == 1
+    # genesis pinning marks wrong-network nodes offline
+    fb.pin_genesis(b"\xaa" * 32)
+    fb.update_all_candidates()
+    assert fb.num_available() == 0
+    with pytest.raises(AllErrored):
+        BeaconNodeFallback(["http://127.0.0.1:1"]).get_genesis()
+
+
+def test_doppelganger_holds_back_then_releases(bn):
+    spec = bn.chain.spec
+    vc = ProductionValidatorClient(
+        spec, bn.http_server.url, enable_doppelganger=True
+    )
+    vc.load_interop_keys(16)
+    vc.connect()
+    clock = bn._clock
+
+    clock.set_slot(1)
+    stats = vc.run_slot(1)  # epoch 0: registration, everything held back
+    assert stats["proposed"] is False and stats["attested"] == 0
+    assert len(vc.store.doppelganger_suspect) == 16
+
+    spe = spec.preset.SLOTS_PER_EPOCH
+    # epoch 1..2: nothing live on the network -> released after 2 checks
+    clock.set_slot(spe)
+    vc.run_slot(spe)
+    clock.set_slot(2 * spe)
+    vc.run_slot(2 * spe)
+    assert len(vc.store.doppelganger_suspect) == 0
+    clock.set_slot(2 * spe + 1)
+    stats = vc.run_slot(2 * spe + 1)
+    assert stats["attested"] > 0
+
+
+def test_doppelganger_flags_live_duplicate(bn):
+    spec = bn.chain.spec
+    # a duplicate VC (no doppelganger) attests first
+    dup = ProductionValidatorClient(spec, bn.http_server.url)
+    dup.load_interop_keys(16)
+    dup.connect()
+    clock = bn._clock
+    spe = spec.preset.SLOTS_PER_EPOCH
+    start = bn.chain.head.slot + 1
+    epoch0 = start // spe
+
+    protected = ProductionValidatorClient(
+        spec, bn.http_server.url, enable_doppelganger=True
+    )
+    protected.load_interop_keys(16)
+    protected.connect()
+
+    clock.set_slot(start)
+    protected.run_slot(start)  # registers watch at epoch0
+    dup.run_slot(start)        # duplicate signs in epoch0
+
+    nxt = (epoch0 + 1) * spe
+    clock.set_slot(nxt)
+    protected.run_slot(nxt)    # checks epoch0 liveness -> duplicate seen
+    assert len(protected.doppelganger.detected()) > 0
+    assert len(protected.store.doppelganger_suspect) == 16
+
+
+def test_web3signer_remote_signing_roundtrip(bn):
+    sks = _sks(4)
+    signer = MockWeb3Signer(sks).start()
+    try:
+        spec = bn.chain.spec
+        vc = ProductionValidatorClient(spec, bn.http_server.url)
+        n = vc.load_web3signer(signer.url)
+        assert n == 4
+        vc.connect()
+        # remote-signed attestation verifies under the local pubkey
+        from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+
+        data = AttestationData(
+            slot=1, index=0,
+            beacon_block_root=b"\x11" * 32,
+            source=Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=Checkpoint(epoch=0, root=b"\x22" * 32),
+        )
+        pk = sks[0].public_key().serialize()
+        sig = vc.store.sign_attestation(pk, data, vc.ctx.fork_info())
+        from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+        domain = get_domain(
+            spec, vc.ctx.fork_info(), spec.DOMAIN_BEACON_ATTESTER, epoch=0
+        )
+        root = compute_signing_root(data, domain)
+        assert bls.verify_signature_sets(
+            [bls.SignatureSet.single_pubkey(
+                sig, bls.PublicKey.from_bytes(pk), root
+            )]
+        )
+    finally:
+        signer.stop()
+
+
+def test_keymanager_crud(tmp_path):
+    from lighthouse_tpu.keys.keystore import Keystore
+
+    spec = minimal_spec()
+    store = ValidatorStore(spec)
+    km = KeymanagerServer(store).start()
+    try:
+        def req(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            r = urllib.request.Request(
+                km.url + path, data=data, method=method,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                return json.loads(resp.read().decode())
+
+        assert req("GET", "/eth/v1/keystores")["data"] == []
+        sk = _sks(1)[0]
+        ks = Keystore.encrypt(sk.serialize(), "pw", path="m/12381/3600/0/0/0")
+        out = req("POST", "/eth/v1/keystores",
+                  {"keystores": [ks.to_json()], "passwords": ["pw"]})
+        assert out["data"][0]["status"] == "imported"
+        listed = req("GET", "/eth/v1/keystores")["data"]
+        pk_hex = "0x" + sk.public_key().serialize().hex()
+        assert listed[0]["validating_pubkey"] == pk_hex
+
+        # remotekeys CRUD
+        out = req("POST", "/eth/v1/remotekeys", {"remote_keys": [
+            {"pubkey": "0x" + _sks(2)[1].public_key().serialize().hex(),
+             "url": "http://127.0.0.1:9"}
+        ]})
+        assert out["data"][0]["status"] == "imported"
+        assert len(req("GET", "/eth/v1/remotekeys")["data"]) == 1
+
+        # delete exports slashing history
+        out = req("DELETE", "/eth/v1/keystores", {"pubkeys": [pk_hex]})
+        assert out["data"][0]["status"] == "deleted"
+        assert "metadata" in out["slashing_protection"]
+        assert req("GET", "/eth/v1/keystores")["data"] == []
+    finally:
+        km.stop()
