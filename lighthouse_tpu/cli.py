@@ -86,13 +86,40 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--slasher", action="store_true")
     bn.add_argument("--interop-validators", type=int, default=64)
     bn.add_argument("--genesis-time", type=int, default=None)
+    bn.add_argument(
+        "--listen-port", type=int, default=None,
+        help="TCP gossip/RPC listener port (0 = ephemeral; unset = no p2p)",
+    )
+    bn.add_argument(
+        "--boot-nodes", default="",
+        help="comma-separated UDP boot-node addresses for peer discovery",
+    )
 
     vc = sub.add_parser("vc", help="validator client")
     _add_spec_flags(vc)
-    vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument(
+        "--beacon-node", default="http://127.0.0.1:5052",
+        help="beacon node URL(s), comma-separated for health-scored failover "
+             "(beacon_node_fallback)",
+    )
     vc.add_argument("--validators-dir", default=None)
     vc.add_argument("--password", default="")
     vc.add_argument("--interop-validators", type=int, default=0)
+    vc.add_argument(
+        "--enable-doppelganger-protection", action="store_true",
+        help="hold back signing until liveness checks show no duplicate "
+             "instance of our keys (doppelganger_service)",
+    )
+    vc.add_argument(
+        "--keymanager-port", type=int, default=None,
+        help="serve the keymanager API (keystores/remotekeys CRUD) on this "
+             "port (0 = ephemeral)",
+    )
+    vc.add_argument(
+        "--web3signer-url", default=None,
+        help="register all keys served by this remote signer "
+             "(signing_method/web3signer)",
+    )
 
     am = sub.add_parser("account-manager", aliases=["am"],
                         help="create validator keystores")
@@ -102,6 +129,12 @@ def build_parser() -> argparse.ArgumentParser:
     am.add_argument("--password", required=True)
     am.add_argument("--mnemonic-seed", default=None,
                     help="hex seed for EIP-2333 derivation (random if unset)")
+
+    boot = sub.add_parser(
+        "boot-node", help="UDP discovery rendezvous (ref boot_node/)"
+    )
+    boot.add_argument("--port", type=int, default=4242)
+    boot.add_argument("--host", default="0.0.0.0")
 
     sub.add_parser("version", help="print version")
     return parser
@@ -121,6 +154,8 @@ def run_bn(args) -> "object":
         interop_validators=args.interop_validators,
         genesis_time=args.genesis_time,
         debug_level=args.debug_level,
+        listen_port=args.listen_port,
+        boot_nodes=args.boot_nodes,
     )
     return ClientBuilder(spec, cfg).build().start()
 
@@ -131,11 +166,17 @@ def run_vc(args):
 
     init_logging(args.debug_level)
     spec = _spec(args)
-    vc = ProductionValidatorClient(spec, args.beacon_node)
+    vc = ProductionValidatorClient(
+        spec, args.beacon_node,
+        enable_doppelganger=args.enable_doppelganger_protection,
+        keymanager_port=args.keymanager_port,
+    )
     if args.validators_dir:
         vc.load_keystore_dir(args.validators_dir, args.password)
     if args.interop_validators:
         vc.load_interop_keys(args.interop_validators)
+    if args.web3signer_url:
+        vc.load_web3signer(args.web3signer_url)
     return vc.connect()
 
 
@@ -186,6 +227,20 @@ def main(argv=None) -> int:
         return 0
     if args.command in ("account-manager", "am"):
         run_account_manager(args)
+        return 0
+    if args.command == "boot-node":
+        import time
+
+        from .network.boot_node import BootNode
+        from .utils.logging import init_logging
+
+        init_logging("info")
+        node = BootNode(host=args.host, port=args.port).start()
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            node.stop()
         return 0
     return 1
 

@@ -36,6 +36,8 @@ class ClientConfig:
     genesis_time: int | None = None  # None = now
     debug_level: str = "info"
     use_system_clock: bool = True
+    listen_port: int | None = None  # TCP gossip/RPC listener (None = no p2p)
+    boot_nodes: str = ""  # comma-separated UDP boot-node addresses
 
 
 class Client:
@@ -117,6 +119,8 @@ class Client:
             self.http_server.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
+        if self.network_service is not None:
+            self.network_service.transport.stop()
 
     def wait_for_shutdown(self) -> None:
         """Block until stop() or KeyboardInterrupt (Environment's shutdown
@@ -190,12 +194,37 @@ class ClientBuilder:
         chain = BeaconChain(self.spec, state, store=store, slot_clock=clock)
         op_pool = OperationPool(self.spec, chain.ns.Attestation)
 
+        network_service = None
+        if cfg.listen_port is not None:
+            from ..network import BeaconNodeService, SocketTransport
+
+            transport = SocketTransport(self.spec, port=cfg.listen_port)
+            network_service = BeaconNodeService(
+                transport.local_addr, self.spec, transport=transport,
+                chain=chain, op_pool=op_pool,
+            )
+            for boot in [b.strip() for b in cfg.boot_nodes.split(",") if b.strip()]:
+                try:
+                    transport.discover(boot)
+                except OSError as e:
+                    log.warn("Boot node unreachable", addr=boot, error=str(e))
+            for peer in transport.peers():
+                try:
+                    network_service.connect(peer)
+                except ConnectionError as e:
+                    log.warn("Peer handshake failed", peer=peer, error=str(e))
+            log.info(
+                "P2P listening", addr=transport.local_addr,
+                peers=len(transport.peers()),
+            )
+
         http_server = None
         if cfg.http_enabled:
             from ..http_api import BeaconApiServer
 
             http_server = BeaconApiServer(
-                chain, op_pool=op_pool, port=cfg.http_port
+                chain, op_pool=op_pool, port=cfg.http_port,
+                network_service=network_service,
             )
 
         metrics_server = None
@@ -219,5 +248,5 @@ class ClientBuilder:
         notifier = Notifier(chain)
         return Client(
             chain, op_pool, http_server, metrics_server, slasher_service,
-            notifier,
+            notifier, network_service=network_service,
         )

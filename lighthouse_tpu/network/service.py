@@ -24,21 +24,28 @@ class BeaconNodeService:
         self,
         node_id: str,
         spec,
-        genesis_state,
-        transport: Transport,
+        genesis_state=None,
+        transport: Transport = None,
         slot_clock=None,
         execution_layer=None,
+        chain: BeaconChain | None = None,
+        op_pool: OperationPool | None = None,
     ):
+        if transport is None:
+            raise ValueError("BeaconNodeService requires a transport")
+        if chain is None and genesis_state is None:
+            raise ValueError("pass either a prebuilt chain or a genesis state")
         self.node_id = node_id
         self.transport = transport
-        self.chain = BeaconChain(
+        # a prebuilt chain (the ClientBuilder path) or a fresh one (tests)
+        self.chain = chain or BeaconChain(
             spec, genesis_state, slot_clock=slot_clock,
             execution_layer=execution_layer,
         )
         self.processor = BeaconProcessor(
             BeaconProcessorConfig(), synchronous=True
         )
-        self.op_pool = OperationPool(spec, self.chain.ns.Attestation)
+        self.op_pool = op_pool or OperationPool(spec, self.chain.ns.Attestation)
         self.router = Router(self)
         self.sync = SyncManager(self)
         transport.register(node_id, self)

@@ -1,0 +1,321 @@
+"""Real-socket transport: TCP gossip mesh + Req/Resp, UDP discovery.
+
+The internet-facing twin of the reference's libp2p stack
+(``lighthouse_network/src/service/mod.rs``): a TCP listener per node carries
+both the gossip mesh and Req/Resp streams; peers are found via the UDP boot
+node (``boot_node/``, the discv5 seam). Gossip propagation is flood-with-dedup:
+every message carries a 20-byte id (hash of topic+payload, the gossipsub
+message-id function); peers forward each id at most once, so messages reach
+the whole connected component without a routing table. Malformed frames
+disconnect the peer (the peer-scoring hook).
+
+Frame layout (length-prefixed, one TCP stream per peer pair):
+
+    u32 len | u8 kind | body
+    kind 0 GOSSIP : u8 topic_len | topic | 20B msg_id | payload
+    kind 1 REQ    : u64 req_id | u8 method_len | method | payload
+    kind 2 RESP   : u64 req_id | payload
+    kind 3 ERROR  : u64 req_id | utf-8 message
+    kind 4 HELLO  : u8 addr_len | addr      (peer's canonical listen address)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+from collections import OrderedDict
+
+from ..utils.logging import get_logger
+from .codec import MessageCodec, WireError
+from .transport import Transport
+
+log = get_logger("socket_transport")
+
+_GOSSIP, _REQ, _RESP, _ERROR, _HELLO = range(5)
+_MAX_FRAME = 1 << 28
+_SEEN_CAP = 4096  # gossipsub duplicate-cache size
+
+
+class _Peer:
+    def __init__(self, sock: socket.socket, addr: str):
+        self.sock = sock
+        self.addr = addr  # canonical "host:port" listen address
+        self.send_lock = threading.Lock()
+        self.alive = True
+
+    def send_frame(self, kind: int, body: bytes) -> None:
+        frame = struct.pack(">IB", len(body) + 1, kind) + body
+        with self.send_lock:
+            self.sock.sendall(frame)
+
+
+class SocketTransport(Transport):
+    """One node's network endpoint. Satisfies the Transport seam the
+    BeaconNodeService/Router/SyncManager stack is written against, so the
+    same node code runs over loopback (tests) or real sockets."""
+
+    def __init__(self, spec, host: str = "127.0.0.1", port: int = 0,
+                 rpc_timeout: float = 10.0):
+        self.codec = MessageCodec(spec)
+        self.rpc_timeout = rpc_timeout
+        self._service = None
+        self._peers: dict[str, _Peer] = {}  # canonical addr -> peer
+        self._lock = threading.Lock()
+        self._seen: OrderedDict[bytes, None] = OrderedDict()
+        self.published = 0  # gossip messages originated here
+        self.delivered = 0  # gossip messages fully processed here
+        self._req_id = 0
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.local_addr = f"{host}:{self._listener.getsockname()[1]}"
+        self._stopped = False
+        threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"net-accept-{self.local_addr}",
+        ).start()
+
+    # -- Transport seam ----------------------------------------------------
+
+    def register(self, peer_id: str, service) -> None:
+        self._service = service
+
+    def peers(self, exclude: str | None = None) -> list[str]:
+        with self._lock:
+            return [a for a in self._peers if a != exclude]
+
+    def publish(self, from_peer: str, topic: str, message) -> None:
+        payload = self.codec.encode_gossip(topic, message)
+        msg_id = hashlib.sha256(topic.encode() + payload).digest()[:20]
+        self._mark_seen(msg_id)
+        self.published += 1
+        body = (
+            bytes([len(topic)]) + topic.encode() + msg_id + payload
+        )
+        self._flood(body, except_addr=None)
+
+    def request(self, from_peer: str, to_peer: str, method: str, payload):
+        peer = self._peers.get(to_peer)
+        if peer is None or not peer.alive:
+            raise ConnectionError(f"not connected to {to_peer}")
+        with self._lock:
+            self._req_id += 1
+            rid = self._req_id
+            ev, box = threading.Event(), []
+            self._pending[rid] = (ev, box)
+        body = (
+            struct.pack(">Q", rid)
+            + bytes([len(method)])
+            + method.encode()
+            + self.codec.encode_request(method, payload)
+        )
+        try:
+            peer.send_frame(_REQ, body)
+            if not ev.wait(self.rpc_timeout):
+                raise ConnectionError(f"rpc {method} to {to_peer} timed out")
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
+        kind, data = box[0]
+        if kind == _ERROR:
+            raise ConnectionError(data.decode(errors="replace"))
+        return self.codec.decode_response(method, data)
+
+    # -- dialing / discovery ----------------------------------------------
+
+    def dial(self, addr: str) -> bool:
+        """Connect to ``host:port``; HELLO exchanges canonical addresses."""
+        if addr == self.local_addr or addr in self._peers:
+            return False
+        host, port = addr.rsplit(":", 1)
+        try:
+            s = socket.create_connection((host, int(port)), timeout=5)
+        except OSError as e:
+            log.warn("Dial failed", addr=addr, error=str(e))
+            return False
+        self._add_peer(s, addr)
+        return True
+
+    def discover(self, boot_addr: str, dial: bool = True) -> list[str]:
+        """Announce to the UDP boot node and dial the peers it returns."""
+        from .boot_node import client_announce
+
+        found = client_announce(boot_addr, self.local_addr)
+        if dial:
+            for addr in found:
+                self.dial(addr)
+        return found
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            peers = list(self._peers.values())
+            self._peers.clear()
+        for p in peers:
+            try:
+                p.sock.close()
+            except OSError:
+                pass
+
+    # -- internals ---------------------------------------------------------
+
+    def _add_peer(self, sock: socket.socket, addr: str) -> _Peer:
+        peer = _Peer(sock, addr)
+        with self._lock:
+            old = self._peers.get(addr)
+            self._peers[addr] = peer
+        if old is not None:
+            try:
+                old.sock.close()
+            except OSError:
+                pass
+        peer.send_frame(
+            _HELLO, bytes([len(self.local_addr)]) + self.local_addr.encode()
+        )
+        threading.Thread(
+            target=self._read_loop, args=(peer,), daemon=True,
+            name=f"net-read-{addr}",
+        ).start()
+        return peer
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                sock, (h, p) = self._listener.accept()
+            except OSError:
+                return
+            # canonical addr arrives in the peer's HELLO; key by socket addr
+            # meanwhile so duplicate dials don't race
+            self._add_peer(sock, f"{h}:{p}")
+
+    def _drop_peer(self, peer: _Peer, why: str) -> None:
+        peer.alive = False
+        with self._lock:
+            if self._peers.get(peer.addr) is peer:
+                del self._peers[peer.addr]
+        try:
+            peer.sock.close()
+        except OSError:
+            pass
+        if why != "closed":
+            log.warn("Peer dropped", addr=peer.addr, reason=why)
+
+    def _mark_seen(self, msg_id: bytes) -> bool:
+        """True if the id is new (and records it)."""
+        with self._lock:
+            if msg_id in self._seen:
+                return False
+            self._seen[msg_id] = None
+            while len(self._seen) > _SEEN_CAP:
+                self._seen.popitem(last=False)
+            return True
+
+    def _flood(self, gossip_body: bytes, except_addr: str | None) -> None:
+        with self._lock:
+            targets = [
+                p for a, p in self._peers.items() if a != except_addr
+            ]
+        for p in targets:
+            try:
+                p.send_frame(_GOSSIP, gossip_body)
+            except OSError:
+                self._drop_peer(p, "send failed")
+
+    def _read_loop(self, peer: _Peer) -> None:
+        buf = b""
+        sock = peer.sock
+        while peer.alive:
+            try:
+                chunk = sock.recv(1 << 16)
+            except OSError:
+                chunk = b""
+            if not chunk:
+                self._drop_peer(peer, "closed")
+                return
+            buf += chunk
+            while len(buf) >= 4:
+                (n,) = struct.unpack(">I", buf[:4])
+                if n > _MAX_FRAME or n < 1:
+                    self._drop_peer(peer, "bad frame length")
+                    return
+                if len(buf) < 4 + n:
+                    break
+                kind, body = buf[4], buf[5 : 4 + n]
+                buf = buf[4 + n :]
+                try:
+                    self._handle_frame(peer, kind, body)
+                except WireError as e:
+                    self._drop_peer(peer, f"codec: {e}")
+                    return
+                except Exception as e:  # noqa: BLE001 — protocol boundary
+                    self._drop_peer(peer, f"handler: {e}")
+                    return
+
+    def _handle_frame(self, peer: _Peer, kind: int, body: bytes) -> None:
+        if kind == _HELLO:
+            n = body[0]
+            canonical = body[1 : 1 + n].decode()
+            stale = None
+            with self._lock:
+                if self._peers.get(peer.addr) is peer:
+                    del self._peers[peer.addr]
+                peer.addr = canonical
+                existing = self._peers.get(canonical)
+                if existing is not None and existing is not peer:
+                    # simultaneous dial: keep exactly one connection per pair,
+                    # deterministically (smaller address keeps its outbound)
+                    keep_new = self.local_addr < canonical
+                    stale = existing if keep_new else peer
+                    self._peers[canonical] = peer if keep_new else existing
+                else:
+                    self._peers[canonical] = peer
+            if stale is not None:
+                stale.alive = False
+                try:
+                    stale.sock.close()
+                except OSError:
+                    pass
+        elif kind == _GOSSIP:
+            tn = body[0]
+            topic = body[1 : 1 + tn].decode()
+            msg_id = body[1 + tn : 21 + tn]
+            payload = body[21 + tn :]
+            if not self._mark_seen(msg_id):
+                return
+            # forward FIRST (gossip latency), then process locally
+            self._flood(body, except_addr=peer.addr)
+            if self._service is not None:
+                message = self.codec.decode_gossip(topic, payload)
+                self._service.on_gossip(topic, message, peer.addr)
+            self.delivered += 1
+        elif kind == _REQ:
+            (rid,) = struct.unpack(">Q", body[:8])
+            mn = body[8]
+            method = body[9 : 9 + mn].decode()
+            payload = self.codec.decode_request(method, body[9 + mn :])
+            try:
+                out = self._service.on_rpc(method, payload, peer.addr)
+                resp = self.codec.encode_response(method, out)
+                peer.send_frame(_RESP, struct.pack(">Q", rid) + resp)
+            except Exception as e:  # noqa: BLE001 — report to the requester
+                peer.send_frame(
+                    _ERROR, struct.pack(">Q", rid) + str(e).encode()
+                )
+        elif kind in (_RESP, _ERROR):
+            (rid,) = struct.unpack(">Q", body[:8])
+            with self._lock:
+                entry = self._pending.get(rid)
+            if entry is not None:
+                ev, box = entry
+                box.append((kind, body[8:]))
+                ev.set()
+        else:
+            raise WireError(f"unknown frame kind {kind}")

@@ -28,31 +28,104 @@ from .harness import StateHarness
 
 
 class LocalNetwork:
-    def __init__(self, spec: ChainSpec, n_nodes: int, n_validators: int):
+    def __init__(self, spec: ChainSpec, n_nodes: int, n_validators: int,
+                 transport: str = "loopback"):
         assert n_validators % n_nodes == 0
         self.spec = spec
-        self.transport = LoopbackTransport()
+        self.mode = transport
         self.clock = ManualSlotClock(0)
         # one harness supplies genesis + deterministic keys; each node only
         # "owns" (signs with) its shard of the validator set
         self.harness = StateHarness(spec, n_validators)
         self.nodes: list[BeaconNodeService] = []
+        self.boot = None
         per = n_validators // n_nodes
         self.owned: list[range] = []
-        for i in range(n_nodes):
-            svc = BeaconNodeService(
-                f"node_{i}",
-                spec,
-                self.harness.state.copy(),
-                self.transport,
-                slot_clock=self.clock,
-                execution_layer=self.harness.el,
-            )
-            self.nodes.append(svc)
-            self.owned.append(range(i * per, (i + 1) * per))
-        for i, svc in enumerate(self.nodes):
-            for peer in self.transport.peers(exclude=svc.node_id):
-                svc.connect(peer)
+        if transport == "loopback":
+            self.transport = LoopbackTransport()
+            for i in range(n_nodes):
+                svc = BeaconNodeService(
+                    f"node_{i}",
+                    spec,
+                    self.harness.state.copy(),
+                    self.transport,
+                    slot_clock=self.clock,
+                    execution_layer=self.harness.el,
+                )
+                self.nodes.append(svc)
+                self.owned.append(range(i * per, (i + 1) * per))
+            for svc in self.nodes:
+                for peer in self.transport.peers(exclude=svc.node_id):
+                    svc.connect(peer)
+        elif transport == "sockets":
+            # real TCP gossip/RPC + UDP boot-node discovery: the same node
+            # stack over lighthouse_tpu.network.socket_transport
+            import time as _time
+
+            from ..network.boot_node import BootNode
+            from ..network.socket_transport import SocketTransport
+
+            self.boot = BootNode().start()
+            for i in range(n_nodes):
+                t = SocketTransport(spec)
+                svc = BeaconNodeService(
+                    t.local_addr,
+                    spec,
+                    self.harness.state.copy(),
+                    t,
+                    slot_clock=self.clock,
+                    execution_layer=self.harness.el,
+                )
+                t.discover(self.boot.local_addr)
+                self.nodes.append(svc)
+                self.owned.append(range(i * per, (i + 1) * per))
+            # wait for the mesh to fully connect under CANONICAL addresses
+            # (HELLO rekeys accept-side ephemeral entries), then handshake
+            addrs = {n.node_id for n in self.nodes}
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline:
+                if all(
+                    set(n.transport.peers()) == addrs - {n.node_id}
+                    for n in self.nodes
+                ):
+                    break
+                _time.sleep(0.01)
+            for svc in self.nodes:
+                for peer in svc.transport.peers():
+                    svc.connect(peer)
+        else:
+            raise ValueError(f"unknown transport mode {transport!r}")
+        self._msg_total = 0  # messages published so far (settle accounting)
+
+    def settle(self, timeout: float = 5.0) -> None:
+        """Wait until every node has RECEIVED and PROCESSED every message
+        published so far (socket mode; loopback is synchronous). Exact
+        accounting: each node's gossip dedup cache must hold all published
+        message ids, and its processor must be idle."""
+        if self.mode == "loopback":
+            return
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if all(
+                n.transport.delivered + n.transport.published
+                >= self._msg_total
+                for n in self.nodes
+            ):
+                return
+            _time.sleep(0.005)
+        raise TimeoutError(
+            f"gossip did not settle: want {self._msg_total}, have "
+            f"{[(n.transport.delivered, n.transport.published) for n in self.nodes]}"
+        )
+
+    def stop(self) -> None:
+        if self.mode == "sockets":
+            for n in self.nodes:
+                n.transport.stop()
+            if self.boot is not None:
+                self.boot.stop()
 
     def _owner_of(self, validator_index: int) -> BeaconNodeService:
         for node, rng in zip(self.nodes, self.owned):
@@ -90,6 +163,7 @@ class LocalNetwork:
         signed = block_cls(message=block, signature=sig)
         node.chain.process_block(signed)
         node.publish_block(signed)
+        self._msg_total += 1
 
     def _attest(self, slot: int) -> None:
         spec = self.spec
@@ -130,11 +204,14 @@ class LocalNetwork:
                     )
                     node.op_pool.insert_attestation(att)
                     node.publish_attestation(att)
+                    self._msg_total += 1
 
     def run_slot(self, slot: int) -> None:
         self.clock.set_slot(slot)
         self._propose(slot)
+        self.settle()
         self._attest(slot)
+        self.settle()
 
     def run_until(self, last_slot: int, start: int = 1) -> None:
         for slot in range(start, last_slot + 1):
