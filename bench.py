@@ -25,9 +25,30 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _probe_accelerator(timeout: float = 180.0) -> bool:
+    """Can the default JAX backend actually run an op? Probed in a SUBPROCESS:
+    a wedged device tunnel blocks inside the client library forever, which a
+    thread cannot interrupt. False -> the caller pins jax to CPU so the bench
+    still produces an honest (if slow) number instead of hanging."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = (jnp.arange(8) + 1).sum(); x.block_until_ready();"
+        "print(jax.devices()[0].platform)"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=timeout
+        )
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 N_SETS = int(os.environ.get("BENCH_SETS", "256"))
 KEYS_PER_SET = int(os.environ.get("BENCH_KEYS", "448"))
@@ -152,6 +173,15 @@ def _bench_native(pks_raw, idx, msgs, sigs) -> float:
 
 
 def main():
+    if not _probe_accelerator():
+        # device init is wedged (e.g. a stuck tunnel): pin CPU BEFORE any jax
+        # import in this process and say so on stderr
+        print(
+            "# accelerator probe hung; falling back to CPU", file=sys.stderr
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     pks_comp, pks_raw, idx, msgs, sigs = _fixture()
     native = _bench_native(pks_raw, idx, msgs, sigs)
     print(f"# native (C++ single-core): {native:.2f} sets/s", flush=True)

@@ -82,6 +82,7 @@ class BeaconChain:
         self.store = store or HotColdDB()
         self.slot_clock = slot_clock or ManualSlotClock(0)
         self.execution_layer = execution_layer
+        self.eth1_service = None  # optional deposit/eth1-data bridge (eth1/)
         from .data_availability import DataAvailabilityChecker
 
         self.da_checker = DataAvailabilityChecker(
@@ -98,7 +99,9 @@ class BeaconChain:
         genesis_root = hdr.tree_root()
         self.genesis_state = genesis_state
         self.genesis_block_root = genesis_root
-        jc = (0, genesis_root)
+        # anchor checkpoint: epoch 0 at genesis, the state's epoch when
+        # booting from a checkpoint-sync state (get_forkchoice_store)
+        jc = (spec.compute_epoch_at_slot(int(genesis_state.slot)), genesis_root)
         self.fork_choice = ForkChoice.from_anchor(
             spec,
             genesis_root,
@@ -711,11 +714,30 @@ class BeaconChain:
         fork = spec.fork_name_at_epoch(get_current_epoch(spec, state))
         body_cls = self.ns.body_types[fork]
         block_cls = self.ns.block_types[fork]
+        eth1_data = state.eth1_data
+        deposits = []
+        if self.eth1_service is not None:
+            eth1_data = self.eth1_service.eth1_data_vote(state)
+            # deposits must match the eth1_data the block's own processing
+            # ends up with: process_eth1_data may adopt OUR vote mid-block
+            # when it reaches the period majority (eth1_chain.rs computes
+            # against the post-vote data for exactly this reason)
+            votes = list(state.eth1_data_votes) + [eth1_data]
+            period = spec.preset.slots_per_eth1_voting_period
+            adopted = (
+                eth1_data
+                if sum(1 for v in votes if v == eth1_data) * 2 > period
+                else state.eth1_data
+            )
+            deposits = self.eth1_service.deposits_for_inclusion(
+                state, eth1_data=adopted
+            )
         body = body_cls(
             randao_reveal=randao_reveal,
-            eth1_data=state.eth1_data,
+            eth1_data=eth1_data,
             graffiti=graffiti,
             attestations=attestations or [],
+            deposits=deposits,
         )
         inner_cls = dict(block_cls.FIELDS)["message"]
         block = inner_cls(

@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--boot-nodes", default="",
         help="comma-separated UDP boot-node addresses for peer discovery",
     )
+    bn.add_argument(
+        "--checkpoint-sync-url", default=None,
+        help="boot from another node's finalized state over HTTP instead of "
+             "genesis (client/src/builder.rs checkpoint-sync branch)",
+    )
 
     vc = sub.add_parser("vc", help="validator client")
     _add_spec_flags(vc)
@@ -157,7 +162,10 @@ def run_bn(args) -> "object":
         listen_port=args.listen_port,
         boot_nodes=args.boot_nodes,
     )
-    return ClientBuilder(spec, cfg).build().start()
+    builder = ClientBuilder(spec, cfg)
+    if args.checkpoint_sync_url:
+        builder.checkpoint_sync(args.checkpoint_sync_url)
+    return builder.build().start()
 
 
 def run_vc(args):

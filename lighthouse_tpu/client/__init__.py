@@ -67,6 +67,10 @@ class Client:
                 name="slasher-tick",
             )
             self._slasher_ticker.start()
+        if self.chain.eth1_service is not None:
+            threading.Thread(
+                target=self._run_eth1_polls, daemon=True, name="eth1-poll"
+            ).start()
         threading.Thread(
             target=self._warmup_bls, daemon=True, name="bls-warmup"
         ).start()
@@ -81,6 +85,15 @@ class Client:
                 self.slasher_service.tick()
             except Exception as e:  # noqa: BLE001 — keep the timer alive
                 log.warning("Slasher tick failed", error=str(e))
+
+    def _run_eth1_polls(self) -> None:
+        """Periodic eth1 follow poll (eth1/src/service.rs update interval)."""
+        sps = self.chain.spec.preset.SECONDS_PER_SLOT
+        while not self._shutdown.wait(sps):
+            try:
+                self.chain.eth1_service.update()
+            except Exception as e:  # noqa: BLE001 — keep polling
+                log.warn("Eth1 poll failed", error=str(e))
 
     def _warmup_bls(self) -> None:
         """Compile the verification kernels off the serving path so the first
@@ -139,6 +152,7 @@ class ClientBuilder:
         self.config = config or ClientConfig()
         self._genesis_state = None
         self._slot_clock = None
+        self._eth1 = None
 
     def interop_genesis(self) -> "ClientBuilder":
         from ..state_transition.genesis import interop_genesis_state
@@ -157,6 +171,28 @@ class ClientBuilder:
         """Boot from a provided state (the checkpoint-sync seam:
         client/src/builder.rs genesis-state branch)."""
         self._genesis_state = state
+        return self
+
+    def checkpoint_sync(self, url: str, state_id: str = "finalized") -> "ClientBuilder":
+        """Fetch a trusted finalized state over HTTP and anchor the chain on
+        it (client/src/builder.rs checkpoint-sync genesis branch; history is
+        filled backwards by sync, not required to serve)."""
+        from ..api_client import BeaconNodeHttpClient
+        from ..types.containers import for_preset
+
+        version, raw = BeaconNodeHttpClient(url).get_state_ssz(state_id)
+        ns = for_preset(self.spec.preset.name)
+        state = ns.state_types[version].decode(raw)
+        log.info(
+            "Checkpoint state fetched",
+            url=url, slot=int(state.slot), fork=version,
+        )
+        self._genesis_state = state
+        return self
+
+    def eth1_service(self, service) -> "ClientBuilder":
+        """Attach a deposit/eth1-data bridge (eth1/Eth1Service)."""
+        self._eth1 = service
         return self
 
     def slot_clock(self, clock) -> "ClientBuilder":
@@ -192,6 +228,8 @@ class ClientBuilder:
                 else ManualSlotClock(0)
             )
         chain = BeaconChain(self.spec, state, store=store, slot_clock=clock)
+        if self._eth1 is not None:
+            chain.eth1_service = self._eth1
         op_pool = OperationPool(self.spec, chain.ns.Attestation)
 
         network_service = None

@@ -345,6 +345,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_atts"),
     ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
     ("POST", re.compile(r"^/eth/v1/validator/liveness/(\d+)$"), "liveness"),
+    ("GET", re.compile(r"^/eth/v2/debug/beacon/states/(head|justified|finalized)$"), "debug_state"),
 ]
 
 # Routes that mutate chain state and therefore serialize on the chain's
@@ -437,6 +438,12 @@ def _make_handler(api: BeaconApiServer):
                 return api.publish_attestations(self._body())
             if name == "header":
                 return api.get_header()
+            if name == "debug_state":
+                st = api._state(match.group(1))
+                spec = api.chain.spec
+                fork = spec.fork_name_at_slot(int(st.slot))
+                state_cls = api.chain.ns.state_types[fork]
+                return {"version": fork, "data": _hex(state_cls.encode(st))}
             if name == "liveness":
                 epoch = int(match.group(1))
                 indices = [int(x) for x in self._body()]

@@ -276,12 +276,23 @@ _RT384_NP = int_to_limbs(_RT384_VAL)
 _RT384_ROW = jnp.asarray(_RT384_NP)
 _RT381_VAL = (1 << 381) % P
 _RT381_ROW = jnp.asarray(int_to_limbs(_RT381_VAL))
+# keep bits < 381: full limbs 0..22, 13 bits of limb 23, none of limb 24
+_MASK_LOW381 = jnp.asarray(
+    np.array([0xFFFF] * 23 + [0x1FFF, 0], dtype=np.uint64)
+)
+
+
+# constant masks (static-index .at[].set lowers to scatter — thousands of
+# scatter ops dominated XLA compile time; a mask multiply fuses for free)
+_MASK_NO24 = jnp.asarray(
+    np.array([1] * 24 + [0], dtype=np.uint64)
+)
 
 
 def _fold_384(t, s: _RState):
     """Fold the 2^384-and-up excess of a 25-limb array through 2^384 mod p."""
     top = t[..., 24]
-    t = t.at[..., 24].set(0) + top[..., None] * _RT384_ROW
+    t = t * _MASK_NO24 + top[..., None] * _RT384_ROW
     top_b = s.limbs[24]
     limbs = [
         b + top_b * int(_RT384_NP[i]) for i, b in enumerate(s.limbs[:24])
@@ -393,10 +404,7 @@ def canonical(a):
     # value < 13p: two sub-limb folds at the 2^381 boundary bring it under 2p
     for _ in range(2):
         hi = (t[..., 23] >> np.uint64(13)) + (t[..., 24] << np.uint64(3))
-        t = (
-            t.at[..., 23].set(t[..., 23] & np.uint64(0x1FFF)).at[..., 24].set(0)
-            + hi[..., None] * _RT381_ROW
-        )
+        t = (t & _MASK_LOW381) + hi[..., None] * _RT381_ROW
         t = _carry_propagate(t, NLIMBS)
     return _cond_sub_p(t)
 

@@ -332,8 +332,7 @@ def carry_norm(x):
     for _ in range(2):
         x = fq._carry_propagate(x, fq.NLIMBS)
         top = x[..., 24]
-        low = x.at[..., 24].set(0)
-        x = low + top[..., None] * _RT384
+        x = x * fq._MASK_NO24 + top[..., None] * _RT384
     return fq._carry_propagate(x, fq.NLIMBS)
 
 

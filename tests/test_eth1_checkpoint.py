@@ -1,0 +1,240 @@
+"""Eth1 bridge + deposit genesis + checkpoint sync.
+
+Refs: beacon_node/eth1 (deposit cache + voting), genesis/eth1_genesis_service
+(initialize_beacon_state_from_eth1), client/src/builder.rs checkpoint-sync
+branch + backfill seam.
+"""
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.eth1 import (
+    DepositCache,
+    DepositLog,
+    Eth1Service,
+    MockEth1Provider,
+    eth1_genesis_state,
+    is_valid_genesis_state,
+)
+from lighthouse_tpu.state_transition.genesis import interop_secret_keys
+from lighthouse_tpu.state_transition.per_block import is_valid_merkle_branch
+from lighthouse_tpu.types.containers import DepositData, DepositMessage
+from lighthouse_tpu.types.helpers import compute_domain, compute_signing_root
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def _deposit_data(spec, sk: bls.SecretKey, amount=32 * 10**9) -> DepositData:
+    pk = sk.public_key().serialize()
+    wc = b"\x00" + bytes(31)
+    msg = DepositMessage(
+        pubkey=pk, withdrawal_credentials=wc, amount=amount
+    )
+    domain = compute_domain(
+        spec.DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+    )
+    sig = sk.sign(compute_signing_root(msg, domain))
+    return DepositData(
+        pubkey=pk, withdrawal_credentials=wc, amount=amount,
+        signature=sig.serialize(),
+    )
+
+
+def _sks(n):
+    return [
+        bls.SecretKey.from_bytes(x.to_bytes(32, "big"))
+        for x in interop_secret_keys(n)
+    ]
+
+
+def test_deposit_cache_roots_and_proofs():
+    spec = minimal_spec()
+    cache = DepositCache()
+    datas = [_deposit_data(spec, sk) for sk in _sks(5)]
+    for i, d in enumerate(datas):
+        cache.insert_log(DepositLog(data=d, block_number=i, index=i))
+    for count in (1, 3, 5):
+        root = cache.deposit_root(count)
+        for dep in cache.get_deposits(0, count, count):
+            pass
+        deps = cache.get_deposits(0, count, count)
+        for i, dep in enumerate(deps):
+            assert is_valid_merkle_branch(
+                DepositData.hash_tree_root(dep.data),
+                dep.proof, 33, i, root,
+            ), (count, i)
+
+
+def test_eth1_genesis_from_deposits():
+    spec = minimal_spec(
+        min_genesis_active_validator_count=8, min_genesis_time=0
+    )
+    datas = [_deposit_data(spec, sk) for sk in _sks(8)]
+    state = eth1_genesis_state(spec, b"\x11" * 32, 1000, datas)
+    assert len(state.validators) == 8
+    assert int(state.eth1_deposit_index) == 8
+    assert is_valid_genesis_state(spec, state)
+    # one deposit below 32 ETH: registered but not active at genesis
+    extra = _deposit_data(spec, _sks(9)[8], amount=16 * 10**9)
+    state2 = eth1_genesis_state(spec, b"\x11" * 32, 1000, datas + [extra])
+    assert len(state2.validators) == 9
+    active = sum(
+        1 for v in state2.validators if int(v.activation_epoch) == 0
+    )
+    assert active == 8
+
+
+def test_eth1_service_voting_and_inclusion():
+    spec = minimal_spec(
+        min_genesis_active_validator_count=8, min_genesis_time=0
+    )
+    provider = MockEth1Provider(genesis_timestamp=0)
+    datas = [_deposit_data(spec, sk) for sk in _sks(10)]
+    for d in datas[:8]:
+        provider.submit_deposit(d)
+    svc = Eth1Service(spec, provider, follow_distance=2)
+    assert svc.update() == 8
+
+    state = eth1_genesis_state(spec, provider.get_block(8).hash,
+                               provider.get_block(8).timestamp, datas[:8])
+    # two more deposits land on chain after genesis
+    for d in datas[8:]:
+        provider.submit_deposit(d)
+    for _ in range(40):  # advance the eth1 chain past the follow window
+        provider.mine_block()
+    svc.update()
+    assert len(svc.deposits) == 10
+
+    # pretend the beacon clock advanced into a later voting period
+    state.slot = spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD * \
+        spec.preset.SLOTS_PER_EPOCH
+    state.genesis_time = 0
+    vote = svc.eth1_data_vote(state)
+    assert int(vote.deposit_count) >= 8
+
+    # adopt the vote (as the end-of-period transition would) and include
+    # the new deposits with proofs the state transition accepts
+    state.eth1_data = vote
+    if int(vote.deposit_count) > 8:
+        deps = svc.deposits_for_inclusion(state)
+        assert len(deps) == int(vote.deposit_count) - 8
+        root = bytes(vote.deposit_root)
+        for i, dep in enumerate(deps, start=8):
+            assert is_valid_merkle_branch(
+                DepositData.hash_tree_root(dep.data), dep.proof, 33, i, root
+            )
+
+
+def test_checkpoint_sync_boot():
+    """Node B boots from node A's finalized state over HTTP and keeps
+    importing blocks produced on A."""
+    from lighthouse_tpu.client import ClientBuilder, ClientConfig
+    from lighthouse_tpu.testing.local_network import LocalNetwork
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    net = LocalNetwork(spec, n_nodes=1, n_validators=16)
+    spe = spec.preset.SLOTS_PER_EPOCH
+    net.run_until(4 * spe)
+    a_chain = net.nodes[0].chain
+    assert int(a_chain.head.state.finalized_checkpoint.epoch) >= 2
+
+    # serve A over HTTP
+    from lighthouse_tpu.http_api import BeaconApiServer
+
+    server = BeaconApiServer(a_chain).start()
+    try:
+        clock = ManualSlotClock(4 * spe)
+        cfg = ClientConfig(use_system_clock=False)
+        b = (
+            ClientBuilder(spec, cfg)
+            .checkpoint_sync(server.url)
+            .slot_clock(clock)
+            .build()
+        )
+        fin_epoch = int(a_chain.head.state.finalized_checkpoint.epoch)
+        assert b.chain.head.slot >= fin_epoch * spe - spe  # anchored near finality
+        assert b.chain.head.slot < 4 * spe  # but behind A's head
+
+        # B imports the canonical blocks past its anchor
+        blocks = net.nodes[0].blocks_by_range(b.chain.head.slot + 1, 4 * spe)
+        clock.set_slot(4 * spe)
+        b.chain.process_chain_segment(blocks)
+        assert b.chain.head.root == a_chain.head.root
+    finally:
+        server.stop()
+
+
+def test_block_production_includes_deposits_on_adopted_vote():
+    """The proposal whose eth1 vote tips the period majority must include
+    the newly-votable deposits — deposits are computed against the POST-vote
+    eth1_data (eth1_chain.rs semantics)."""
+    from lighthouse_tpu.beacon_chain.chain import BeaconChain
+    from lighthouse_tpu.state_transition import per_block_processing
+    from lighthouse_tpu.state_transition import BlockSignatureStrategy
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    spec = minimal_spec(
+        min_genesis_active_validator_count=8, min_genesis_time=0,
+        altair_fork_epoch=2**64 - 1,
+    )
+    provider = MockEth1Provider(genesis_timestamp=0)
+    datas = [_deposit_data(spec, sk) for sk in _sks(10)]
+    for d in datas[:8]:
+        provider.submit_deposit(d)
+    genesis_block = provider.get_block(provider.latest_block_number())
+    state = eth1_genesis_state(
+        spec, genesis_block.hash, genesis_block.timestamp, datas[:8]
+    )
+    state.genesis_time = 0
+
+    svc = Eth1Service(spec, provider, follow_distance=2)
+    for d in datas[8:]:
+        provider.submit_deposit(d)
+    provider.mine_block()  # eth1 tracks the beacon clock; no unbounded race
+    svc.update()
+
+    chain = BeaconChain(spec, state, slot_clock=ManualSlotClock(0))
+    chain.eth1_service = svc
+    slot = spec.preset.slots_per_eth1_voting_period
+    chain.slot_clock.set_slot(slot)
+
+    # stuff the state's vote list so OUR vote reaches the period majority
+    from lighthouse_tpu.state_transition import process_slots
+
+    work = state.copy()
+    process_slots(spec, work, slot)
+    vote = svc.eth1_data_vote(work)
+    assert int(vote.deposit_count) == 10
+    period = spec.preset.slots_per_eth1_voting_period
+    work.eth1_data_votes = [vote] * (period // 2)
+
+    from lighthouse_tpu.state_transition.genesis import interop_secret_keys
+    from lighthouse_tpu.types.containers import SigningData
+    from lighthouse_tpu.types.helpers import get_domain
+    from lighthouse_tpu.ssz import uint64
+
+    epoch = slot // spec.preset.SLOTS_PER_EPOCH
+    domain = get_domain(spec, work, spec.DOMAIN_RANDAO, epoch=epoch)
+    root = SigningData(
+        object_root=uint64.hash_tree_root(epoch), domain=domain
+    ).tree_root()
+    from lighthouse_tpu.state_transition import get_beacon_proposer_index
+
+    proposer = get_beacon_proposer_index(spec, work)
+    sk = _sks(10)[proposer]
+    reveal = sk.sign(root).serialize()
+
+    block, post = chain.produce_block_on_state(work, slot, reveal)
+    # the block adopted the vote and included the two owed deposits
+    assert bytes(block.body.eth1_data.block_hash) == bytes(vote.block_hash)
+    assert len(block.body.deposits) == 2
+    assert int(post.eth1_deposit_index) == 10
+    assert len(post.validators) == 10
