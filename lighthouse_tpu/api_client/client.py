@@ -175,6 +175,11 @@ class BeaconNodeHttpClient:
     def get_validator_liveness(self, epoch: int, indices: list[int]):
         return self._post(f"/eth/v1/validator/liveness/{epoch}", indices)["data"]
 
+    def get_block_ssz(self, block_id) -> tuple[str, bytes]:
+        """Signed block by slot/root/'head' (fork-versioned SSZ)."""
+        d = self._get(f"/eth/v2/beacon/blocks/{block_id}")["data"]
+        return d["version"], _unhex(d["data"])
+
     def get_state_ssz(self, state_id: str = "finalized") -> tuple[str, bytes]:
         """Full BeaconState SSZ (the checkpoint-sync fetch; debug API)."""
         d = self._get(f"/eth/v2/debug/beacon/states/{state_id}")["data"]

@@ -135,6 +135,42 @@ def build_parser() -> argparse.ArgumentParser:
     am.add_argument("--mnemonic-seed", default=None,
                     help="hex seed for EIP-2333 derivation (random if unset)")
 
+    dm = sub.add_parser(
+        "database-manager", aliases=["dm"],
+        help="inspect/migrate/compact the on-disk stores (ref database_manager/)",
+    )
+    _add_spec_flags(dm)
+    dm.add_argument("command_db", choices=("inspect", "version", "migrate", "compact"))
+    dm.add_argument("--datadir", required=True)
+
+    lcli = sub.add_parser(
+        "lcli", help="dev utilities: skip-slots, transition-blocks, pretty-ssz"
+    )
+    _add_spec_flags(lcli)
+    lcli.add_argument(
+        "command_lcli", choices=("skip-slots", "transition-blocks", "pretty-ssz")
+    )
+    lcli.add_argument("--pre-state", help="input state SSZ file")
+    lcli.add_argument("--output", help="output file (state SSZ / JSON)")
+    lcli.add_argument("--slots", type=int, default=1)
+    lcli.add_argument("--blocks", nargs="*", default=[], help="block SSZ files")
+    lcli.add_argument("--type", dest="ssz_type", help="container name")
+    lcli.add_argument("--ssz-file", help="SSZ input for pretty-ssz")
+
+    vm = sub.add_parser(
+        "validator-manager", aliases=["vm"],
+        help="bulk create/import validators (ref validator_manager/)",
+    )
+    _add_spec_flags(vm)
+    vm.add_argument("command_vm", choices=("create", "import", "list"))
+    vm.add_argument("--output-dir")
+    vm.add_argument("--keystores-dir")
+    vm.add_argument("--count", type=int, default=1)
+    vm.add_argument("--first-index", type=int, default=0)
+    vm.add_argument("--password", default="")
+    vm.add_argument("--mnemonic-seed", default=None)
+    vm.add_argument("--vc-url", help="running VC keymanager API url")
+
     boot = sub.add_parser(
         "boot-node", help="UDP discovery rendezvous (ref boot_node/)"
     )
@@ -235,6 +271,79 @@ def main(argv=None) -> int:
         return 0
     if args.command in ("account-manager", "am"):
         run_account_manager(args)
+        return 0
+    if args.command in ("database-manager", "dm"):
+        from . import tools
+
+        fn = {
+            "inspect": tools.db_inspect, "version": tools.db_version,
+            "migrate": tools.db_migrate, "compact": tools.db_compact,
+        }[args.command_db]
+        print(json.dumps(fn(args.datadir), indent=2))
+        return 0
+    if args.command == "lcli":
+        from . import tools
+
+        need = {
+            "skip-slots": ("pre_state", "output"),
+            "transition-blocks": ("pre_state", "output"),
+            "pretty-ssz": ("ssz_file", "ssz_type"),
+        }[args.command_lcli]
+        missing = [n for n in need if not getattr(args, n)]
+        if missing:
+            build_parser().error(
+                f"lcli {args.command_lcli} requires "
+                + ", ".join("--" + n.replace("_", "-") for n in missing)
+            )
+        spec = _spec(args)
+        if args.command_lcli == "skip-slots":
+            with open(args.pre_state, "rb") as fh:
+                out = tools.skip_slots(spec, fh.read(), args.slots)
+            with open(args.output, "wb") as fh:
+                fh.write(out)
+            print(json.dumps({"wrote": args.output, "bytes": len(out)}))
+        elif args.command_lcli == "transition-blocks":
+            with open(args.pre_state, "rb") as fh:
+                pre = fh.read()
+            blocks = []
+            for b in args.blocks:
+                with open(b, "rb") as fh:
+                    blocks.append(fh.read())
+            out = tools.transition_blocks(spec, pre, blocks)
+            with open(args.output, "wb") as fh:
+                fh.write(out)
+            print(json.dumps({"wrote": args.output, "bytes": len(out)}))
+        else:
+            with open(args.ssz_file, "rb") as fh:
+                obj = tools.pretty_ssz(spec, args.ssz_type, fh.read())
+            print(json.dumps(obj, indent=2))
+        return 0
+    if args.command in ("validator-manager", "vm"):
+        from . import tools
+
+        need = {
+            "create": ("output_dir",),
+            "import": ("keystores_dir", "vc_url"),
+            "list": ("vc_url",),
+        }[args.command_vm]
+        missing = [n for n in need if not getattr(args, n)]
+        if missing:
+            build_parser().error(
+                f"validator-manager {args.command_vm} requires "
+                + ", ".join("--" + n.replace("_", "-") for n in missing)
+            )
+        if args.command_vm == "create":
+            written = tools.vm_create(
+                args.output_dir, args.count, args.password,
+                args.mnemonic_seed, args.first_index,
+            )
+            print(json.dumps({"wrote": written, "dir": args.output_dir}))
+        elif args.command_vm == "import":
+            print(json.dumps(
+                tools.vm_import(args.keystores_dir, args.password, args.vc_url)
+            ))
+        else:
+            print(json.dumps(tools.vm_list(args.vc_url)))
         return 0
     if args.command == "boot-node":
         import time

@@ -306,6 +306,64 @@ class BeaconApiServer:
             raise ApiError(400, json.dumps(failures))
         return {}
 
+    def _signed_block(self, root: bytes):
+        """Decoded signed block by root: memory cache first, then the store
+        (finalized blocks are migrated out of memory but stay on disk)."""
+        chain = self.chain
+        sb = chain._blocks.get(root)
+        if sb is not None:
+            return sb
+        raw = chain.store.get_block(root)
+        if raw is None:
+            return None
+        for fork in reversed(list(chain.ns.block_types)):
+            try:
+                return chain.ns.block_types[fork].decode(raw)
+            except Exception:
+                continue
+        return None
+
+    def get_block(self, block_id: str):
+        """Signed block by 'head', slot number, or 0x-root (fork-versioned
+        SSZ envelope; /eth/v2/beacon/blocks/{block_id})."""
+        chain = self.chain
+        if block_id == "head":
+            root = chain.head.root
+        elif block_id.startswith("0x"):
+            root = _unhex(block_id)
+        elif block_id.isdigit():
+            # canonical walk from head, bounded by the head slot; store
+            # fallback covers migrated (finalized) history
+            want = int(block_id)
+            if want > chain.head.slot:
+                raise ApiError(404, f"no canonical block at slot {want}")
+            root = chain.head.root
+            found = None
+            while root is not None:
+                sb = self._signed_block(root)
+                if sb is None:
+                    break
+                s = int(sb.message.slot)
+                if s == want:
+                    found = root
+                    break
+                if s < want:
+                    break
+                if root == chain.genesis_block_root:
+                    break
+                root = bytes(sb.message.parent_root)
+            if found is None:
+                raise ApiError(404, f"no canonical block at slot {want}")
+            root = found
+        else:
+            raise ApiError(400, f"unsupported block id {block_id!r}")
+        sb = self._signed_block(root)
+        if sb is None:
+            raise ApiError(404, f"block {root.hex()[:16]} not held")
+        fork = chain.spec.fork_name_at_slot(int(sb.message.slot))
+        cls = chain.ns.block_types[fork]
+        return {"version": fork, "data": _hex(cls.encode(sb))}
+
     def get_header(self):
         head = self.chain.head
         return {
@@ -346,6 +404,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
     ("POST", re.compile(r"^/eth/v1/validator/liveness/(\d+)$"), "liveness"),
     ("GET", re.compile(r"^/eth/v2/debug/beacon/states/(head|justified|finalized)$"), "debug_state"),
+    ("GET", re.compile(r"^/eth/v2/beacon/blocks/(\w+)$"), "block"),
 ]
 
 # Routes that mutate chain state and therefore serialize on the chain's
@@ -438,6 +497,8 @@ def _make_handler(api: BeaconApiServer):
                 return api.publish_attestations(self._body())
             if name == "header":
                 return api.get_header()
+            if name == "block":
+                return api.get_block(match.group(1))
             if name == "debug_state":
                 st = api._state(match.group(1))
                 spec = api.chain.spec

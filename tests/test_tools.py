@@ -1,0 +1,115 @@
+"""Operator tooling: database-manager, lcli utilities, validator-manager.
+
+Refs: database_manager/ (inspect/migrate), lcli/ (skip-slots,
+transition-blocks, pretty-ssz), validator_manager/ (bulk create + import
+through the keymanager API).
+"""
+
+import json
+
+import pytest
+
+from lighthouse_tpu import bls, tools
+from lighthouse_tpu.cli import main as cli_main
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def chain_dir(tmp_path_factory):
+    """A datadir with a few persisted slots (for db tooling)."""
+    from lighthouse_tpu.client import ClientBuilder, ClientConfig
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+    from lighthouse_tpu.validator_client.runner import ProductionValidatorClient
+
+    path = tmp_path_factory.mktemp("bn_data")
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    clock = ManualSlotClock(0)
+    cfg = ClientConfig(
+        datadir=str(path), interop_validators=8, genesis_time=0,
+        use_system_clock=False,
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock)
+        .build().start()
+    )
+    vc = ProductionValidatorClient(spec, client.http_server.url)
+    vc.load_interop_keys(8)
+    vc.connect()
+    for slot in range(1, 4):
+        clock.set_slot(slot)
+        vc.run_slot(slot)
+    chain = client.chain
+    client.stop()
+    yield str(path), spec, chain
+
+
+def test_db_inspect_and_version(chain_dir, capsys):
+    path, spec, _ = chain_dir
+    out = tools.db_inspect(path)
+    assert "chain.db" in out
+    assert any("Block" in c for c in out["chain.db"])  # blocks persisted
+    v = tools.db_version(path)
+    assert v["schema_version"] == v["current"]
+    # through the CLI
+    cli_main(["database-manager", "inspect", "--datadir", path])
+    assert "chain.db" in capsys.readouterr().out
+    assert tools.db_migrate(path)["to"] == v["current"]
+    tools.db_compact(path)
+
+
+def test_lcli_skip_slots_and_transition(chain_dir, tmp_path):
+    path, spec, chain = chain_dir
+    ns = chain.ns
+    genesis = chain.genesis_state
+    fork = spec.fork_name_at_slot(0)
+    state_ssz = ns.state_types[fork].encode(genesis)
+
+    out = tools.skip_slots(spec, state_ssz, 3)
+    advanced = ns.state_types[fork].decode(out)
+    assert int(advanced.slot) == 3
+
+    # replay the real chain blocks onto genesis
+    blocks = []
+    root = chain.head.root
+    while root != chain.genesis_block_root:
+        sb = chain._blocks[root]
+        blocks.append(ns.block_types[fork].encode(sb))
+        root = bytes(sb.message.parent_root)
+    blocks.reverse()
+    post = tools.transition_blocks(spec, state_ssz, blocks)
+    post_state = ns.state_types[fork].decode(post)
+    assert int(post_state.slot) == chain.head.slot
+
+    # pretty-ssz round trip on a block
+    obj = tools.pretty_ssz(spec, "SignedBeaconBlock", blocks[-1]) if hasattr(
+        ns, "SignedBeaconBlock"
+    ) else None
+    blk = ns.block_types[fork].decode(blocks[-1])
+    pretty = tools._to_jsonable(blk)
+    assert pretty["message"]["slot"] == chain.head.slot
+
+
+def test_validator_manager_roundtrip(tmp_path):
+    from lighthouse_tpu.validator_client import KeymanagerServer, ValidatorStore
+
+    spec = minimal_spec()
+    written = tools.vm_create(
+        str(tmp_path), count=3, password="pw", seed_hex="ab" * 32
+    )
+    assert len(written) == 3
+    store = ValidatorStore(spec)
+    km = KeymanagerServer(store).start()
+    try:
+        statuses = tools.vm_import(str(tmp_path), "pw", km.url)
+        assert [s["status"] for s in statuses] == ["imported"] * 3
+        assert len(tools.vm_list(km.url)) == 3
+    finally:
+        km.stop()

@@ -1,0 +1,74 @@
+"""Watch chain-analytics service (ref watch/): ingest + query API."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client.runner import ProductionValidatorClient
+from lighthouse_tpu.watch import WatchDB, WatchServer, WatchService
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def test_watch_ingests_and_serves():
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    clock = ManualSlotClock(0)
+    cfg = ClientConfig(
+        interop_validators=8, genesis_time=0, use_system_clock=False
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock)
+        .build().start()
+    )
+    try:
+        vc = ProductionValidatorClient(spec, client.http_server.url)
+        vc.load_interop_keys(8)
+        vc.connect()
+        for slot in range(1, 6):
+            clock.set_slot(slot)
+            vc.run_slot(slot)
+
+        db = WatchDB()
+        svc = WatchService(db, client.http_server.url, spec)
+        rows = svc.update()
+        assert rows == 5
+        assert svc.update() == 0  # idempotent follow
+
+        assert db.slot_bounds() == (1, 5)
+        blk = db.block(3)
+        assert blk is not None and blk["slot"] == 3
+        assert blk["attestation_count"] >= 0
+
+        # every proposal in the window is attributed to some proposer
+        attributed = sum(
+            len(db.blocks_by_proposer(i)) for i in range(8)
+        )
+        assert attributed == 5
+        part = db.participation(1, 5)
+        assert part["blocks"] == 5
+
+        server = WatchServer(db).start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(server.url + path, timeout=10) as r:
+                    return json.loads(r.read().decode())
+
+            assert get("/v1/slots/highest")["data"]["slot"] == 5
+            assert get("/v1/slots/lowest")["data"]["slot"] == 1
+            assert get("/v1/blocks/2")["data"]["slot"] == 2
+            assert get("/v1/participation?lo=1&hi=5")["data"]["blocks"] == 5
+        finally:
+            server.stop()
+    finally:
+        client.stop()
