@@ -175,6 +175,17 @@ class BeaconNodeHttpClient:
     def get_validator_liveness(self, epoch: int, indices: list[int]):
         return self._post(f"/eth/v1/validator/liveness/{epoch}", indices)["data"]
 
+    def get_sync_duties(self, epoch: int, indices: list[int]):
+        return self._post(f"/eth/v1/validator/duties/sync/{epoch}", indices)[
+            "data"
+        ]
+
+    def publish_sync_messages(self, msgs_ssz: list[bytes]) -> None:
+        self._post(
+            "/eth/v1/beacon/pool/sync_committees",
+            [{"data": _hex(m)} for m in msgs_ssz],
+        )
+
     def get_block_ssz(self, block_id) -> tuple[str, bytes]:
         """Signed block by slot/root/'head' (fork-versioned SSZ)."""
         d = self._get(f"/eth/v2/beacon/blocks/{block_id}")["data"]

@@ -83,6 +83,11 @@ class BeaconChain:
         self.slot_clock = slot_clock or ManualSlotClock(0)
         self.execution_layer = execution_layer
         self.eth1_service = None  # optional deposit/eth1-data bridge (eth1/)
+        from ..op_pool.sync_aggregation import SyncContributionPool
+
+        self.sync_contribution_pool = SyncContributionPool(
+            spec.preset.SYNC_COMMITTEE_SIZE
+        )
         from .data_availability import DataAvailabilityChecker
 
         self.da_checker = DataAvailabilityChecker(
@@ -142,6 +147,11 @@ class BeaconChain:
         # attesting or proposing. Pruned to the last few epochs.
         self._observed_attesters: dict[int, set[int]] = {}
         self._observed_proposers: dict[int, set[int]] = {}
+        # light-client server: bootstraps + latest optimistic/finality
+        # updates from imported sync aggregates (light_client_server_cache.rs)
+        from ..light_client import LightClientServerCache
+
+        self.light_client_cache = LightClientServerCache(self)
 
     def _record_liveness(self, table: dict, epoch: int, indices) -> None:
         s = table.setdefault(epoch, set())
@@ -666,6 +676,159 @@ class BeaconChain:
                     self._notify_attestation_observers(indexed)
         return results
 
+    # -- sync committee messages (sync_committee_verification.rs) ----------
+
+    def _sync_signing_root(self, state, slot: int, beacon_block_root: bytes):
+        from ..types.helpers import sync_committee_signing_root
+
+        return sync_committee_signing_root(
+            self.spec, state, slot, beacon_block_root
+        )
+
+    def sync_committee_positions(self, state, validator_index: int) -> list[int]:
+        if not 0 <= int(validator_index) < len(state.validators):
+            return []
+        pk = bytes(state.validators[int(validator_index)].pubkey)
+        return [
+            i
+            for i, cpk in enumerate(state.current_sync_committee.pubkeys)
+            if bytes(cpk) == pk
+        ]
+
+    def verify_sync_committee_messages(self, messages) -> list:
+        """Batch gossip verification of SyncCommitteeMessages; on success the
+        message is merged into the sync contribution pool. Returns
+        (message, committee_positions | error) pairs
+        (verify_sync_committee_message_for_gossip + the naive pool insert)."""
+        state = self.head.state
+        prepared = []
+        for msg in messages:
+            try:
+                positions = self.sync_committee_positions(
+                    state, int(msg.validator_index)
+                )
+                if not positions:
+                    raise AttestationError("not in current sync committee")
+                root = self._sync_signing_root(
+                    state, int(msg.slot), bytes(msg.beacon_block_root)
+                )
+                item = ([int(msg.validator_index)], root, bytes(msg.signature))
+                prepared.append((msg, positions, item))
+            except AttestationError as e:
+                prepared.append((msg, e, None))
+        items = [p[2] for p in prepared if p[2] is not None]
+        results = []
+        if items and self._batch_verify_items(items):
+            for msg, positions, _ in prepared:
+                results.append((msg, positions))
+        else:
+            for msg, positions, item in prepared:
+                if item is None:
+                    results.append((msg, positions))
+                elif self._batch_verify_items([item]):
+                    results.append((msg, positions))
+                else:
+                    results.append(
+                        (msg, AttestationError("invalid sync signature"))
+                    )
+        for msg, verdict in results:
+            if not isinstance(verdict, Exception):
+                self.sync_contribution_pool.insert_message(
+                    int(msg.slot), bytes(msg.beacon_block_root), verdict,
+                    bytes(msg.signature),
+                )
+        return results
+
+    def verify_sync_contributions(self, signed_contributions) -> list:
+        """Gossip verification of SignedContributionAndProofs — THREE sets
+        each (selection proof, contribution-and-proof envelope, and the
+        subcommittee aggregate), batched with per-item fallback
+        (sync_committee_verification.rs contribution path). Verified
+        contributions merge into the sync contribution pool."""
+        from ..types.helpers import compute_signing_root, get_domain
+
+        state = self.head.state
+        sub_size = self.spec.preset.SYNC_COMMITTEE_SIZE // 4
+        prepared = []
+        for sc in signed_contributions:
+            try:
+                cp = sc.message
+                contribution = cp.contribution
+                aggor = int(cp.aggregator_index)
+                if self.pubkey_cache.get(aggor) is None:
+                    raise AttestationError("unknown aggregator index")
+                sub = int(contribution.subcommittee_index)
+                if sub >= 4:
+                    raise AttestationError("subcommittee index out of range")
+                epoch = self.spec.compute_epoch_at_slot(int(contribution.slot))
+                sel_data = self.ns.SyncAggregatorSelectionData(
+                    slot=int(contribution.slot), subcommittee_index=sub
+                )
+                dom_sel = get_domain(
+                    self.spec, state,
+                    self.spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+                    epoch=epoch,
+                )
+                root_sel = compute_signing_root(sel_data, dom_sel)
+                dom_cp = get_domain(
+                    self.spec, state, self.spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+                    epoch=epoch,
+                )
+                root_cp = compute_signing_root(cp, dom_cp)
+                # participants: committee seats in this subcommittee at the
+                # set bits, resolved to validator indices via the pubkey cache
+                bits = np.asarray(contribution.aggregation_bits, dtype=bool)
+                indices = []
+                for pos, bit in enumerate(bits):
+                    if not bit:
+                        continue
+                    pk = bytes(
+                        state.current_sync_committee.pubkeys[
+                            sub * sub_size + pos
+                        ]
+                    )
+                    idx = self.pubkey_cache.get_index(pk)
+                    if idx is None:
+                        raise AttestationError("unknown committee pubkey")
+                    indices.append(idx)
+                if not indices:
+                    raise AttestationError("empty contribution")
+                root_msg = self._sync_signing_root(
+                    state, int(contribution.slot),
+                    bytes(contribution.beacon_block_root),
+                )
+                items = [
+                    ([aggor], root_sel, bytes(cp.selection_proof)),
+                    ([aggor], root_cp, bytes(sc.signature)),
+                    (indices, root_msg, bytes(contribution.signature)),
+                ]
+                prepared.append((sc, items))
+            except AttestationError as e:
+                prepared.append((sc, e))
+        all_items = [it for _, its in prepared if not isinstance(its, Exception) for it in its]
+        results = []
+        if all_items and self._batch_verify_items(all_items):
+            for sc, its in prepared:
+                results.append(
+                    (sc, its if isinstance(its, Exception) else True)
+                )
+        else:
+            for sc, its in prepared:
+                if isinstance(its, Exception):
+                    results.append((sc, its))
+                elif self._batch_verify_items(its):
+                    results.append((sc, True))
+                else:
+                    results.append(
+                        (sc, AttestationError("invalid contribution signature"))
+                    )
+        for sc, verdict in results:
+            if not isinstance(verdict, Exception):
+                self.sync_contribution_pool.insert_contribution(
+                    sc.message.contribution
+                )
+        return results
+
     def _attestation_state(self, att):
         root = bytes(att.data.beacon_block_root)
         state = self._states.get(root)
@@ -685,6 +848,7 @@ class BeaconChain:
     def _recompute_head_locked(self) -> bytes:
         with FORK_CHOICE_GET_HEAD_TIMES.time():
             head_root = self.fork_choice.get_head(self.current_slot())
+        self.sync_contribution_pool.prune(self.current_slot())
         self._maybe_migrate()
         if head_root != self.head.root:
             state = self._states.get(head_root)
@@ -714,6 +878,15 @@ class BeaconChain:
         fork = spec.fork_name_at_epoch(get_current_epoch(spec, state))
         body_cls = self.ns.body_types[fork]
         block_cls = self.ns.block_types[fork]
+        body_fields = {n for n, _ in body_cls.FIELDS}
+        sync_aggregate = None
+        if "sync_aggregate" in body_fields:
+            # altair+: best pooled aggregate for the parent root at slot-1,
+            # else the empty INFINITY aggregate (a zero default signature is
+            # not a valid empty aggregate, blst INFINITY convention)
+            sync_aggregate = self.sync_contribution_pool.get_sync_aggregate(
+                self.ns, slot - 1, parent_root
+            )
         eth1_data = state.eth1_data
         deposits = []
         if self.eth1_service is not None:
@@ -732,13 +905,16 @@ class BeaconChain:
             deposits = self.eth1_service.deposits_for_inclusion(
                 state, eth1_data=adopted
             )
-        body = body_cls(
+        body_kwargs = dict(
             randao_reveal=randao_reveal,
             eth1_data=eth1_data,
             graffiti=graffiti,
             attestations=attestations or [],
             deposits=deposits,
         )
+        if sync_aggregate is not None:
+            body_kwargs["sync_aggregate"] = sync_aggregate
+        body = body_cls(**body_kwargs)
         inner_cls = dict(block_cls.FIELDS)["message"]
         block = inner_cls(
             slot=slot, proposer_index=proposer, parent_root=parent_root,

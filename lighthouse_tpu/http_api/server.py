@@ -199,6 +199,72 @@ class BeaconApiServer:
                         )
         return duties
 
+    def get_sync_duties(self, epoch: int, indices: list[int]):
+        """Sync-committee duties (duties/sync/{epoch}): committee positions
+        per requested validator, computed on a state advanced to the
+        requested epoch (period boundaries rotate the committee)."""
+        spec = self.chain.spec
+        state = self.chain.head.state
+        if not hasattr(state, "current_sync_committee"):
+            return []
+        start = spec.start_slot(epoch)
+        if state.slot < start:
+            state = state.copy()
+            process_slots(spec, state, start)
+        out = []
+        for idx in indices:
+            positions = self.chain.sync_committee_positions(state, idx)
+            if positions:
+                out.append(
+                    {
+                        "pubkey": _hex(state.validators[idx].pubkey),
+                        "validator_index": str(idx),
+                        "validator_sync_committee_indices": [
+                            str(p) for p in positions
+                        ],
+                    }
+                )
+        return out
+
+    def publish_sync_messages(self, body: list):
+        """POST /eth/v1/beacon/pool/sync_committees: verify + pool."""
+        ns = self.chain.ns
+        msgs = [
+            ns.SyncCommitteeMessage.decode(_unhex(item["data"]))
+            for item in body
+        ]
+        results = self.chain.verify_sync_committee_messages(msgs)
+        failures = [
+            {"index": i, "message": str(v)}
+            for i, (_, v) in enumerate(results)
+            if isinstance(v, Exception)
+        ]
+        if failures:
+            raise ApiError(400, f"sync messages rejected: {failures}")
+        if self.network is not None:
+            publish = getattr(self.network, "publish_sync_message", None)
+            if publish is not None:
+                for m in msgs:
+                    publish(m)
+        return {"accepted": len(msgs)}
+
+    def publish_contributions(self, body: list):
+        """POST /eth/v1/validator/contribution_and_proofs."""
+        ns = self.chain.ns
+        scs = [
+            ns.SignedContributionAndProof.decode(_unhex(item["data"]))
+            for item in body
+        ]
+        results = self.chain.verify_sync_contributions(scs)
+        failures = [
+            {"index": i, "message": str(v)}
+            for i, (_, v) in enumerate(results)
+            if isinstance(v, Exception)
+        ]
+        if failures:
+            raise ApiError(400, f"contributions rejected: {failures}")
+        return {"accepted": len(scs)}
+
     def get_attestation_data(self, slot: int, committee_index: int):
         spec = self.chain.spec
         # one snapshot: a concurrent import swaps chain.head atomically, so
@@ -403,13 +469,19 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_atts"),
     ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
     ("POST", re.compile(r"^/eth/v1/validator/liveness/(\d+)$"), "liveness"),
+    ("POST", re.compile(r"^/eth/v1/validator/duties/sync/(\d+)$"), "sync_duties"),
+    ("POST", re.compile(r"^/eth/v1/beacon/pool/sync_committees$"), "publish_sync"),
+    ("POST", re.compile(r"^/eth/v1/validator/contribution_and_proofs$"), "publish_contributions"),
     ("GET", re.compile(r"^/eth/v2/debug/beacon/states/(head|justified|finalized)$"), "debug_state"),
     ("GET", re.compile(r"^/eth/v2/beacon/blocks/(\w+)$"), "block"),
+    ("GET", re.compile(r"^/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-fA-F]{64})$"), "lc_bootstrap"),
+    ("GET", re.compile(r"^/eth/v1/beacon/light_client/optimistic_update$"), "lc_optimistic"),
+    ("GET", re.compile(r"^/eth/v1/beacon/light_client/finality_update$"), "lc_finality"),
 ]
 
 # Routes that mutate chain state and therefore serialize on the chain's
 # mutation lock. Everything else reads immutable snapshots.
-_MUTATING = {"publish_block", "publish_atts"}
+_MUTATING = {"publish_block", "publish_atts", "publish_sync", "publish_contributions"}
 
 
 def _make_handler(api: BeaconApiServer):
@@ -497,6 +569,30 @@ def _make_handler(api: BeaconApiServer):
                 return api.publish_attestations(self._body())
             if name == "header":
                 return api.get_header()
+            if name == "lc_bootstrap":
+                b = api.chain.light_client_cache.bootstrap(
+                    _unhex(match.group(1))
+                )
+                if b is None:
+                    raise ApiError(404, "bootstrap unavailable for root")
+                return _hex(type(b).encode(b))
+            if name == "lc_optimistic":
+                u = api.chain.light_client_cache.latest_optimistic
+                if u is None:
+                    raise ApiError(404, "no optimistic update yet")
+                return _hex(type(u).encode(u))
+            if name == "lc_finality":
+                u = api.chain.light_client_cache.latest_finality
+                if u is None:
+                    raise ApiError(404, "no finality update yet")
+                return _hex(type(u).encode(u))
+            if name == "sync_duties":
+                indices = [int(x) for x in self._body()]
+                return api.get_sync_duties(int(match.group(1)), indices)
+            if name == "publish_sync":
+                return api.publish_sync_messages(self._body())
+            if name == "publish_contributions":
+                return api.publish_contributions(self._body())
             if name == "block":
                 return api.get_block(match.group(1))
             if name == "debug_state":

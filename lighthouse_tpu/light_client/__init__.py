@@ -1,0 +1,26 @@
+"""Light-client server + verification (ref ``consensus/types`` LightClient*
+containers, ``beacon_chain/src/light_client_server_cache.rs``, and the spec's
+altair light-client sync protocol).
+
+The server cache subscribes to block imports: every altair+ block whose sync
+aggregate meets MIN_SYNC_COMMITTEE_PARTICIPANTS yields an optimistic update
+(the aggregate attests the parent header) and, when the attested state knows a
+finalized header, a finality update. Bootstraps (header + current sync
+committee + merkle branch) are served per finalized block root. Branches are
+REAL SSZ proofs generated from the state's field tree
+(ssz.merkle.merkle_branch_from_chunks) and verify against the spec
+generalized indices (current=54, next=55, finality root=105 for a 32-field
+state tree).
+"""
+
+from .proofs import field_branch
+from .server_cache import LightClientServerCache
+from .types import light_client_types
+from .verify import verify_light_client_update
+
+__all__ = [
+    "LightClientServerCache",
+    "field_branch",
+    "light_client_types",
+    "verify_light_client_update",
+]

@@ -1,0 +1,117 @@
+"""Light-client server cache (ref light_client_server_cache.rs).
+
+Subscribes to the chain's block-import seam. Each altair+ block's sync
+aggregate attests the PARENT header; when participation meets
+MIN_SYNC_COMMITTEE_PARTICIPANTS the cache refreshes its latest optimistic and
+finality updates. Bootstraps are computed on demand from a held state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.containers import BeaconBlockHeader
+from .proofs import field_branch
+from .types import light_client_types
+
+
+def _header_for(signed_block) -> BeaconBlockHeader:
+    blk = signed_block.message
+    return BeaconBlockHeader(
+        slot=int(blk.slot),
+        proposer_index=int(blk.proposer_index),
+        parent_root=bytes(blk.parent_root),
+        state_root=bytes(blk.state_root),
+        body_root=type(blk.body).hash_tree_root(blk.body),
+    )
+
+
+class LightClientServerCache:
+    def __init__(self, chain):
+        self.chain = chain
+        self.latest_optimistic = None
+        self.latest_finality = None
+        chain.block_observers.append(self.on_imported_block)
+
+    def _types_at_slot(self, slot: int):
+        """Branch depths follow the fork's state-tree depth."""
+        fork = self.chain.spec.fork_name_at_slot(int(slot))
+        return light_client_types(self.chain.spec.preset.name, fork)
+
+    # -- ingest (block_observers seam) --------------------------------------
+
+    def on_imported_block(self, signed_block) -> None:
+        blk = signed_block.message
+        agg = getattr(blk.body, "sync_aggregate", None)
+        if agg is None:
+            return
+        bits = np.asarray(agg.sync_committee_bits, dtype=bool)
+        if bits.sum() < self.chain.spec.preset.MIN_SYNC_COMMITTEE_PARTICIPANTS:
+            return
+        parent_root = bytes(blk.parent_root)
+        attested_block = self.chain._blocks.get(parent_root)
+        attested_state = self.chain._states.get(parent_root)
+        if attested_block is None or attested_state is None:
+            return
+        # recency guard: a late import of an OLDER block must not regress
+        # the served updates (light_client_server_cache.rs is-latest check)
+        if (
+            self.latest_optimistic is not None
+            and int(blk.slot)
+            <= int(self.latest_optimistic.signature_slot)
+        ):
+            return
+        t = self._types_at_slot(int(attested_block.message.slot))
+        attested_header = t.LightClientHeader(
+            beacon=_header_for(attested_block)
+        )
+        self.latest_optimistic = t.LightClientOptimisticUpdate(
+            attested_header=attested_header,
+            sync_aggregate=agg,
+            signature_slot=int(blk.slot),
+        )
+        fin_cp = attested_state.finalized_checkpoint
+        fin_root = bytes(fin_cp.root)
+        fin_block = self.chain._blocks.get(fin_root)
+        if fin_block is None or fin_root == b"\x00" * 32:
+            return
+        self.latest_finality = t.LightClientFinalityUpdate(
+            attested_header=attested_header,
+            finalized_header=t.LightClientHeader(
+                beacon=_header_for(fin_block)
+            ),
+            finality_branch=field_branch(
+                attested_state, ["finalized_checkpoint", "root"]
+            ),
+            sync_aggregate=agg,
+            signature_slot=int(blk.slot),
+        )
+
+    # -- serving ------------------------------------------------------------
+
+    def bootstrap(self, block_root: bytes):
+        """LightClientBootstrap for a held block root (the trusted checkpoint
+        a light client starts from)."""
+        root = bytes(block_root)
+        state = self.chain.state_by_root(root)
+        if state is None or not hasattr(state, "current_sync_committee"):
+            return None
+        sb = self.chain._blocks.get(root)
+        if sb is not None:
+            header = _header_for(sb)
+        elif root == self.chain.genesis_block_root:
+            # the anchor has no SignedBeaconBlock: its header is the state's
+            # latest_block_header with the state root filled in
+            header = state.latest_block_header.copy()
+            if bytes(header.state_root) == b"\x00" * 32:
+                header.state_root = state.tree_root()
+        else:
+            return None
+        t = self._types_at_slot(int(header.slot))
+        return t.LightClientBootstrap(
+            header=t.LightClientHeader(beacon=header),
+            current_sync_committee=state.current_sync_committee,
+            current_sync_committee_branch=field_branch(
+                state, ["current_sync_committee"]
+            ),
+        )

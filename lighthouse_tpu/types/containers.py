@@ -245,6 +245,42 @@ def for_preset(preset_name: str) -> SimpleNamespace:
             ("sync_committee_signature", BLSSignature),
         ]
 
+    class SyncCommitteeMessage(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("beacon_block_root", Root),
+            ("validator_index", uint64),
+            ("signature", BLSSignature),
+        ]
+
+    class SyncCommitteeContribution(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("beacon_block_root", Root),
+            ("subcommittee_index", uint64),
+            ("aggregation_bits", Bitvector(p.SYNC_COMMITTEE_SIZE // 4)),
+            ("signature", BLSSignature),
+        ]
+
+    class SyncAggregatorSelectionData(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("subcommittee_index", uint64),
+        ]
+
+    class ContributionAndProof(Container):
+        FIELDS = [
+            ("aggregator_index", uint64),
+            ("contribution", SyncCommitteeContribution),
+            ("selection_proof", BLSSignature),
+        ]
+
+    class SignedContributionAndProof(Container):
+        FIELDS = [
+            ("message", ContributionAndProof),
+            ("signature", BLSSignature),
+        ]
+
     class BeaconBlockBody(Container):
         FIELDS = [
             ("randao_reveal", BLSSignature),
@@ -660,6 +696,11 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         HistoricalBatch=HistoricalBatch,
         SyncCommittee=SyncCommittee,
         SyncAggregate=SyncAggregate,
+        SyncCommitteeMessage=SyncCommitteeMessage,
+        SyncAggregatorSelectionData=SyncAggregatorSelectionData,
+        SyncCommitteeContribution=SyncCommitteeContribution,
+        ContributionAndProof=ContributionAndProof,
+        SignedContributionAndProof=SignedContributionAndProof,
         BeaconBlockBody=BeaconBlockBody,
         BeaconBlock=BeaconBlock,
         SignedBeaconBlock=SignedBeaconBlock,

@@ -71,3 +71,19 @@ def is_slashable_attestation_data(d1, d2) -> bool:
         d1.source.epoch < d2.source.epoch and d2.target.epoch < d1.target.epoch
     )
     return double or surround
+
+
+def sync_committee_signing_root(spec, state_or_fork_info, slot: int,
+                                beacon_block_root: bytes) -> bytes:
+    """Signing root of a sync-committee message: the block root under the
+    sync-committee domain of ``slot``'s epoch. Shared by the BN verifier and
+    the VC signer so the two can never diverge."""
+    from .containers import SigningData
+
+    domain = get_domain(
+        spec, state_or_fork_info, spec.DOMAIN_SYNC_COMMITTEE,
+        epoch=spec.compute_epoch_at_slot(int(slot)),
+    )
+    return SigningData(
+        object_root=bytes(beacon_block_root), domain=domain
+    ).tree_root()

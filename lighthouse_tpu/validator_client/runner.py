@@ -21,6 +21,7 @@ from .services import (
     AttestationService,
     BlockService,
     DutiesService,
+    SyncCommitteeService,
     ValidatorClientContext,
 )
 from .validator_store import ValidatorStore
@@ -113,6 +114,7 @@ class ProductionValidatorClient:
         self.duties = DutiesService(self.client, self.store)
         self.attestations = AttestationService(self.ctx, self.duties)
         self.blocks = BlockService(self.ctx, self.duties)
+        self.sync_committee = SyncCommitteeService(self.ctx, self.duties)
         g = self.ctx.genesis
         self.client.pin_genesis(g.genesis_validators_root)
         self.client.update_all_candidates()
@@ -142,7 +144,11 @@ class ProductionValidatorClient:
             self._last_duties_epoch = epoch
         proposed = self.blocks.propose(slot)
         attested = self.attestations.attest(slot)
-        return {"slot": slot, "proposed": proposed, "attested": attested}
+        synced = self.sync_committee.sign_and_publish(slot)
+        return {
+            "slot": slot, "proposed": proposed, "attested": attested,
+            "sync_signed": synced,
+        }
 
     def run(self, genesis_time: int | None = None) -> None:
         """Wall-clock duty loop until stop() (the tokio interval loop)."""

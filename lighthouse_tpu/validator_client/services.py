@@ -133,6 +133,56 @@ class AttestationService:
         return len(published)
 
 
+class SyncCommitteeService:
+    """Per-slot sync-committee duty (sync_committee_service.rs): every owned
+    validator in the current committee signs the head root each slot."""
+
+    def __init__(self, ctx: ValidatorClientContext, duties: DutiesService):
+        self.ctx = ctx
+        self.duties = duties
+        self._duty_cache: dict[int, list] = {}  # epoch -> sync duties
+
+    def _sync_duties(self, epoch: int) -> list:
+        if epoch not in self._duty_cache:
+            indices = self.duties.validator_indices()
+            self._duty_cache[epoch] = self.ctx.client.get_sync_duties(
+                epoch, sorted(indices.values())
+            )
+            self._duty_cache = {
+                e: d for e, d in self._duty_cache.items() if e >= epoch - 1
+            }
+        return self._duty_cache[epoch]
+
+    def sign_and_publish(self, slot: int) -> int:
+        spec = self.ctx.store.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        duties = self._sync_duties(epoch)
+        if not duties:
+            return 0
+        head = self.ctx.client.get_head_header()
+        fork_info = self.ctx.fork_info()
+        ns = for_preset(spec.preset.name)
+        out = []
+        for duty in duties:
+            pubkey = bytes.fromhex(duty["pubkey"][2:])
+            try:
+                sig = self.ctx.store.sign_sync_committee_message(
+                    pubkey, slot, head["root"], fork_info
+                )
+            except NotSafe:
+                continue
+            msg = ns.SyncCommitteeMessage(
+                slot=slot,
+                beacon_block_root=head["root"],
+                validator_index=int(duty["validator_index"]),
+                signature=sig.serialize(),
+            )
+            out.append(ns.SyncCommitteeMessage.encode(msg))
+        if out:
+            self.ctx.client.publish_sync_messages(out)
+        return len(out)
+
+
 class BlockService:
     """Proposer duty execution (block_service.rs): randao sign -> produce via
     BN -> sign -> publish."""

@@ -147,6 +147,18 @@ class ValidatorStore:
         root = compute_signing_root(agg_and_proof, domain)
         return method.sign(root)
 
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, beacon_block_root: bytes, state
+    ) -> bls.Signature:
+        from ..types.helpers import sync_committee_signing_root
+
+        method = self._method(pubkey)
+        return method.sign(
+            sync_committee_signing_root(
+                self.spec, state, slot, beacon_block_root
+            )
+        )
+
     def sign_voluntary_exit(self, pubkey: bytes, exit_msg, state) -> bls.Signature:
         method = self._method(pubkey)
         domain = get_domain(

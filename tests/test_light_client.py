@@ -1,0 +1,135 @@
+"""Light-client server + verification (refs: light_client_server_cache.rs,
+consensus/types LightClient* containers, spec altair sync protocol)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.light_client import (
+    field_branch,
+    light_client_types,
+    verify_light_client_update,
+)
+from lighthouse_tpu.light_client.proofs import leaf_gindex
+from lighthouse_tpu.light_client.verify import verify_bootstrap
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client.runner import ProductionValidatorClient
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def test_spec_generalized_indices():
+    from lighthouse_tpu.types.containers import for_preset
+
+    st = for_preset("minimal").state_types["altair"]
+    assert leaf_gindex(st, ["current_sync_committee"]) == 54
+    assert leaf_gindex(st, ["next_sync_committee"]) == 55
+    assert leaf_gindex(st, ["finalized_checkpoint", "root"]) == 105
+
+
+def test_field_branch_proves_leaves():
+    from lighthouse_tpu.state_transition.genesis import interop_genesis_state
+    from lighthouse_tpu.state_transition.per_block import is_valid_merkle_branch
+
+    spec = minimal_spec(altair_fork_epoch=0)
+    state = interop_genesis_state(spec, 16, 0)
+    root = state.tree_root()
+    cls = type(state.current_sync_committee)
+    branch = field_branch(state, ["current_sync_committee"])
+    assert is_valid_merkle_branch(
+        cls.hash_tree_root(state.current_sync_committee), branch, 5, 22, root
+    )
+    branch = field_branch(state, ["finalized_checkpoint", "root"])
+    assert is_valid_merkle_branch(
+        bytes(state.finalized_checkpoint.root), branch, 6, 105 - 64, root
+    )
+
+
+def test_light_client_follows_chain():
+    """A light client bootstraps from a trusted root and verifies the
+    server's optimistic + finality updates signed by the real sync
+    committee."""
+    spec = minimal_spec(altair_fork_epoch=0)
+    clock = ManualSlotClock(0)
+    cfg = ClientConfig(
+        interop_validators=16, genesis_time=0, use_system_clock=False
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock)
+        .build().start()
+    )
+    try:
+        vc = ProductionValidatorClient(spec, client.http_server.url)
+        vc.load_interop_keys(16)
+        vc.connect()
+        spe = spec.preset.SLOTS_PER_EPOCH
+        for slot in range(1, 4 * spe + 2):
+            clock.set_slot(slot)
+            vc.run_slot(slot)
+
+        chain = client.chain
+        cache = chain.light_client_cache
+        t = light_client_types("minimal")
+        gvr = bytes(chain.genesis_state.genesis_validators_root)
+
+        # bootstrap from the genesis root (the light client's trusted anchor)
+        boot = cache.bootstrap(chain.genesis_block_root)
+        assert boot is not None
+        assert verify_bootstrap(spec, boot, chain.genesis_block_root)
+        committee = boot.current_sync_committee
+
+        # optimistic update verifies under the bootstrap committee
+        opt = cache.latest_optimistic
+        assert opt is not None
+        assert int(np.asarray(
+            opt.sync_aggregate.sync_committee_bits
+        ).sum()) > 0
+        assert verify_light_client_update(spec, opt, committee, gvr)
+
+        # finality update carries a valid finality branch + signature
+        fin = cache.latest_finality
+        assert fin is not None
+        assert verify_light_client_update(
+            spec, fin, committee, gvr, finality_required=True
+        )
+        assert int(fin.finalized_header.beacon.slot) <= int(
+            fin.attested_header.beacon.slot
+        )
+
+        # HTTP surface serves the SSZ envelopes
+        import json
+        import urllib.request
+
+        def get(path):
+            with urllib.request.urlopen(
+                client.http_server.url + path, timeout=10
+            ) as r:
+                return json.loads(r.read().decode())["data"]
+
+        raw = get(
+            "/eth/v1/beacon/light_client/bootstrap/0x"
+            + chain.genesis_block_root.hex()
+        )
+        boot2 = t.LightClientBootstrap.decode(
+            bytes.fromhex(raw[2:])
+        )
+        assert verify_bootstrap(spec, boot2, chain.genesis_block_root)
+        raw = get("/eth/v1/beacon/light_client/optimistic_update")
+        opt2 = t.LightClientOptimisticUpdate.decode(bytes.fromhex(raw[2:]))
+        assert verify_light_client_update(spec, opt2, committee, gvr)
+
+        # a tampered aggregate is rejected
+        bad = t.LightClientOptimisticUpdate.decode(bytes.fromhex(raw[2:]))
+        hdr = bad.attested_header.beacon
+        hdr.proposer_index = int(hdr.proposer_index) + 1
+        assert not verify_light_client_update(spec, bad, committee, gvr)
+    finally:
+        client.stop()

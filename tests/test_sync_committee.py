@@ -1,0 +1,230 @@
+"""Sync-committee pipeline: VC sync duties -> gossip messages -> pooled
+aggregate -> block inclusion -> bulk signature verification on import.
+
+Refs: validator_client/validator_services sync_committee_service.rs,
+beacon_chain/src/sync_committee_verification.rs, operation_pool get_sync_aggregate
+(lib.rs:156).
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client.runner import ProductionValidatorClient
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def test_sync_committee_end_to_end():
+    spec = minimal_spec(altair_fork_epoch=0)
+    clock = ManualSlotClock(0)
+    cfg = ClientConfig(
+        interop_validators=16, genesis_time=0, use_system_clock=False
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock)
+        .build().start()
+    )
+    try:
+        vc = ProductionValidatorClient(spec, client.http_server.url)
+        vc.load_interop_keys(16)
+        vc.connect()
+        total_sync = 0
+        for slot in range(1, 6):
+            clock.set_slot(slot)
+            stats = vc.run_slot(slot)
+            assert stats["proposed"], stats
+            total_sync += stats["sync_signed"]
+        # every slot all 16 validators hold committee seats (minimal
+        # committee size 32 across 16 validators -> every validator serves)
+        assert total_sync > 0
+        assert client.chain.head.slot == 5
+
+        # blocks after the first carry a NON-EMPTY verified sync aggregate
+        root = client.chain.head.root
+        aggregates = []
+        while root != client.chain.genesis_block_root:
+            sb = client.chain._blocks[root]
+            agg = sb.message.body.sync_aggregate
+            aggregates.append(
+                int(np.asarray(agg.sync_committee_bits).sum())
+            )
+            root = bytes(sb.message.parent_root)
+        aggregates.reverse()
+        # slot 1's block aggregates messages signed at slot 0 (none);
+        # from slot 2 on, participation flows
+        assert all(a > 0 for a in aggregates[1:]), aggregates
+    finally:
+        client.stop()
+
+
+def test_sync_message_rejected_for_bad_signature():
+    spec = minimal_spec(altair_fork_epoch=0)
+    clock = ManualSlotClock(1)
+    cfg = ClientConfig(
+        interop_validators=16, genesis_time=0, use_system_clock=False
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock)
+        .build().start()
+    )
+    try:
+        chain = client.chain
+        ns = chain.ns
+        sk = bls.SecretKey.from_bytes((99).to_bytes(32, "big"))
+        msg = ns.SyncCommitteeMessage(
+            slot=1,
+            beacon_block_root=chain.head.root,
+            validator_index=0,
+            signature=sk.sign(b"\x22" * 32).serialize(),  # wrong root + key
+        )
+        results = chain.verify_sync_committee_messages([msg])
+        assert isinstance(results[0][1], Exception)
+        # nothing was pooled
+        agg = chain.sync_contribution_pool.get_sync_aggregate(
+            ns, 1, chain.head.root
+        )
+        assert not np.asarray(agg.sync_committee_bits).any()
+    finally:
+        client.stop()
+
+
+def test_contribution_merging():
+    from lighthouse_tpu.op_pool.sync_aggregation import SyncContributionPool
+    from lighthouse_tpu.types.containers import for_preset
+
+    ns = for_preset("minimal")
+    spec = minimal_spec()
+    size = spec.preset.SYNC_COMMITTEE_SIZE
+    pool = SyncContributionPool(size)
+    sk1 = bls.SecretKey.from_bytes((1).to_bytes(32, "big"))
+    sk2 = bls.SecretKey.from_bytes((2).to_bytes(32, "big"))
+    root = b"\x11" * 32
+    pool.insert_message(5, root, [0, 3], sk1.sign(b"m" * 32).serialize())
+    pool.insert_message(5, root, [7], sk2.sign(b"m" * 32).serialize())
+    agg = pool.get_sync_aggregate(ns, 5, root)
+    bits = np.asarray(agg.sync_committee_bits)
+    assert bits[0] and bits[3] and bits[7] and bits.sum() == 3
+    # overlapping insert is ignored (naive aggregation)
+    pool.insert_message(5, root, [3], sk2.sign(b"m" * 32).serialize())
+    assert np.asarray(
+        pool.get_sync_aggregate(ns, 5, root).sync_committee_bits
+    ).sum() == 3
+    # subcommittee contribution covers its slice
+    sub_bits = np.zeros(size // 4, dtype=bool)
+    sub_bits[1] = True
+    contrib = ns.SyncCommitteeContribution(
+        slot=6, beacon_block_root=root, subcommittee_index=2,
+        aggregation_bits=sub_bits,
+        signature=sk1.sign(b"n" * 32).serialize(),
+    )
+    pool.insert_contribution(contrib)
+    bits6 = np.asarray(
+        pool.get_sync_aggregate(ns, 6, root).sync_committee_bits
+    )
+    assert bits6[2 * (size // 4) + 1] and bits6.sum() == 1
+    pool.prune(20)
+    assert not np.asarray(
+        pool.get_sync_aggregate(ns, 5, root).sync_committee_bits
+    ).any()
+
+
+def test_contribution_and_proof_verification():
+    """SignedContributionAndProof: selection proof + envelope + subcommittee
+    aggregate all verify; bad envelope is rejected."""
+    from lighthouse_tpu.state_transition.genesis import interop_secret_keys
+    from lighthouse_tpu.types.helpers import (
+        compute_signing_root,
+        get_domain,
+        sync_committee_signing_root,
+    )
+
+    spec = minimal_spec(altair_fork_epoch=0)
+    clock = ManualSlotClock(1)
+    cfg = ClientConfig(
+        interop_validators=16, genesis_time=0, use_system_clock=False
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock)
+        .build().start()
+    )
+    try:
+        chain = client.chain
+        ns = chain.ns
+        state = chain.head.state
+        sks = {
+            bls.SecretKey.from_bytes(
+                x.to_bytes(32, "big")
+            ).public_key().serialize(): bls.SecretKey.from_bytes(
+                x.to_bytes(32, "big")
+            )
+            for x in interop_secret_keys(16)
+        }
+        size = spec.preset.SYNC_COMMITTEE_SIZE
+        sub_size = size // 4
+        sub = 1
+        # participants: first two seats of subcommittee 1
+        bits = np.zeros(sub_size, dtype=bool)
+        bits[0] = bits[1] = True
+        root_msg = sync_committee_signing_root(
+            spec, state, 1, chain.head.root
+        )
+        from lighthouse_tpu.ops.bls_oracle import curves as oc
+
+        pts = []
+        for pos in (0, 1):
+            pk = bytes(state.current_sync_committee.pubkeys[sub * sub_size + pos])
+            pts.append(oc.g2_decompress(sks[pk].sign(root_msg).serialize()))
+        agg_sig = oc.g2_compress(oc.g2_add(pts[0], pts[1]))
+
+        aggor_pk = bytes(state.validators[3].pubkey)
+        aggor_sk = sks[aggor_pk]
+        sel_data = ns.SyncAggregatorSelectionData(slot=1, subcommittee_index=sub)
+        dom_sel = get_domain(
+            spec, state, spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch=0
+        )
+        sel_proof = aggor_sk.sign(compute_signing_root(sel_data, dom_sel))
+        contribution = ns.SyncCommitteeContribution(
+            slot=1, beacon_block_root=chain.head.root,
+            subcommittee_index=sub, aggregation_bits=bits,
+            signature=agg_sig,
+        )
+        cp = ns.ContributionAndProof(
+            aggregator_index=3, contribution=contribution,
+            selection_proof=sel_proof.serialize(),
+        )
+        dom_cp = get_domain(
+            spec, state, spec.DOMAIN_CONTRIBUTION_AND_PROOF, epoch=0
+        )
+        sc = ns.SignedContributionAndProof(
+            message=cp,
+            signature=aggor_sk.sign(
+                compute_signing_root(cp, dom_cp)
+            ).serialize(),
+        )
+        results = chain.verify_sync_contributions([sc])
+        assert results[0][1] is True, results
+        agg = chain.sync_contribution_pool.get_sync_aggregate(
+            ns, 1, chain.head.root
+        )
+        got = np.asarray(agg.sync_committee_bits)
+        assert got[sub * sub_size] and got[sub * sub_size + 1]
+        assert got.sum() == 2
+
+        # tampered envelope rejected
+        bad = ns.SignedContributionAndProof(
+            message=cp, signature=aggor_sk.sign(b"\x55" * 32).serialize()
+        )
+        results = chain.verify_sync_contributions([bad])
+        assert isinstance(results[0][1], Exception)
+    finally:
+        client.stop()
