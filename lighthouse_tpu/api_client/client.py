@@ -175,6 +175,19 @@ class BeaconNodeHttpClient:
     def get_validator_liveness(self, epoch: int, indices: list[int]):
         return self._post(f"/eth/v1/validator/liveness/{epoch}", indices)["data"]
 
+    def get_aggregate_attestation(self, data_root: bytes) -> bytes:
+        d = self._get(
+            "/eth/v1/validator/aggregate_attestation"
+            f"?attestation_data_root={_hex(data_root)}"
+        )["data"]
+        return _unhex(d)
+
+    def publish_aggregate_and_proofs(self, saps_ssz: list[bytes]) -> None:
+        self._post(
+            "/eth/v1/validator/aggregate_and_proofs",
+            [{"data": _hex(s)} for s in saps_ssz],
+        )
+
     def get_sync_duties(self, epoch: int, indices: list[int]):
         return self._post(f"/eth/v1/validator/duties/sync/{epoch}", indices)[
             "data"

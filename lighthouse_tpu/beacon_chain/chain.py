@@ -88,6 +88,9 @@ class BeaconChain:
         self.sync_contribution_pool = SyncContributionPool(
             spec.preset.SYNC_COMMITTEE_SIZE
         )
+        from ..op_pool.naive_aggregation import NaiveAggregationPool
+
+        self.naive_aggregation_pool = NaiveAggregationPool(self.ns.Attestation)
         from .data_availability import DataAvailabilityChecker
 
         self.da_checker = DataAvailabilityChecker(
@@ -604,7 +607,11 @@ class BeaconChain:
                         )
                     except Exception:
                         pass
+                    self.naive_aggregation_pool.insert(att)
                     self._notify_attestation_observers(indexed)
+            # prune under the same lock that serializes inserts — gossip
+            # workers and HTTP threads call this path concurrently
+            self.naive_aggregation_pool.prune(self.current_slot())
         return results
 
     def verify_aggregated_attestations(self, signed_aggregates) -> list:
@@ -628,6 +635,26 @@ class BeaconChain:
                 aggor = int(agg.aggregator_index)
                 if self.pubkey_cache.get(aggor) is None:
                     raise AttestationError("unknown aggregator index")
+                # spec is_aggregator: the selection proof must actually
+                # select this validator for the committee (the signature
+                # check alone lets ANY committee member aggregate)
+                import hashlib as _hl
+
+                from ..state_transition import get_beacon_committee
+
+                committee = get_beacon_committee(
+                    self.spec, state, int(att.data.slot), int(att.data.index)
+                )
+                if aggor not in [int(v) for v in committee]:
+                    raise AttestationError("aggregator not in committee")
+                modulo = max(
+                    1,
+                    committee.size
+                    // self.spec.target_aggregators_per_committee,
+                )
+                digest = _hl.sha256(bytes(agg.selection_proof)).digest()
+                if int.from_bytes(digest[0:8], "little") % modulo != 0:
+                    raise AttestationError("selection proof does not select")
                 epoch = self.spec.compute_epoch_at_slot(att.data.slot)
                 dom_sel = get_domain(
                     self.spec, state, self.spec.DOMAIN_SELECTION_PROOF, epoch=epoch

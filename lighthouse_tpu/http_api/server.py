@@ -248,6 +248,40 @@ class BeaconApiServer:
                     publish(m)
         return {"accepted": len(msgs)}
 
+    def get_aggregate_attestation(self, data_root: bytes):
+        """GET /eth/v1/validator/aggregate_attestation: the naive pool's best
+        aggregate for an AttestationData root."""
+        agg = self.chain.naive_aggregation_pool.get_by_root(data_root)
+        if agg is None:
+            raise ApiError(404, "no aggregate for data root")
+        cls = self.chain.ns.Attestation
+        return _hex(cls.encode(agg))
+
+    def publish_aggregates(self, body: list):
+        """POST /eth/v1/validator/aggregate_and_proofs: the 3-sets-per-
+        aggregate batch verification path + op pool insert."""
+        ns = self.chain.ns
+        saps = [
+            ns.SignedAggregateAndProof.decode(_unhex(item["data"]))
+            for item in body
+        ]
+        results = self.chain.verify_aggregated_attestations(saps)
+        failures = []
+        accepted = 0
+        for i, (sap, verdict) in enumerate(results):
+            if isinstance(verdict, Exception):
+                failures.append({"index": i, "message": str(verdict)})
+                continue
+            accepted += 1
+            if self.op_pool is not None:
+                self.op_pool.insert_attestation(sap.message.aggregate)
+            if self.network is not None:
+                self.network.publish_aggregate(sap)
+        if failures:
+            # valid aggregates are already applied; report the rest
+            raise ApiError(400, f"aggregates rejected: {failures}")
+        return {"accepted": accepted}
+
     def publish_contributions(self, body: list):
         """POST /eth/v1/validator/contribution_and_proofs."""
         ns = self.chain.ns
@@ -472,6 +506,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/eth/v1/validator/duties/sync/(\d+)$"), "sync_duties"),
     ("POST", re.compile(r"^/eth/v1/beacon/pool/sync_committees$"), "publish_sync"),
     ("POST", re.compile(r"^/eth/v1/validator/contribution_and_proofs$"), "publish_contributions"),
+    ("GET", re.compile(r"^/eth/v1/validator/aggregate_attestation$"), "aggregate_att"),
+    ("POST", re.compile(r"^/eth/v1/validator/aggregate_and_proofs$"), "publish_aggregates"),
     ("GET", re.compile(r"^/eth/v2/debug/beacon/states/(head|justified|finalized)$"), "debug_state"),
     ("GET", re.compile(r"^/eth/v2/beacon/blocks/(\w+)$"), "block"),
     ("GET", re.compile(r"^/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-fA-F]{64})$"), "lc_bootstrap"),
@@ -481,7 +517,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
 
 # Routes that mutate chain state and therefore serialize on the chain's
 # mutation lock. Everything else reads immutable snapshots.
-_MUTATING = {"publish_block", "publish_atts", "publish_sync", "publish_contributions"}
+_MUTATING = {"publish_block", "publish_atts", "publish_sync", "publish_contributions", "publish_aggregates"}
 
 
 def _make_handler(api: BeaconApiServer):
@@ -593,6 +629,12 @@ def _make_handler(api: BeaconApiServer):
                 return api.publish_sync_messages(self._body())
             if name == "publish_contributions":
                 return api.publish_contributions(self._body())
+            if name == "aggregate_att":
+                return api.get_aggregate_attestation(
+                    _unhex(q["attestation_data_root"])
+                )
+            if name == "publish_aggregates":
+                return api.publish_aggregates(self._body())
             if name == "block":
                 return api.get_block(match.group(1))
             if name == "debug_state":

@@ -66,6 +66,10 @@ class MessageCodec:
             raw = ProposerSlashing.encode(message)
         elif topic == Topic.ATTESTER_SLASHING:
             raw = ns.AttesterSlashing.encode(message)
+        elif topic == Topic.SYNC_COMMITTEE_MESSAGE:
+            raw = ns.SyncCommitteeMessage.encode(message)
+        elif topic == Topic.SYNC_CONTRIBUTION:
+            raw = ns.SignedContributionAndProof.encode(message)
         else:
             raise WireError(f"no codec for topic {topic}")
         return zlib.compress(raw)
@@ -85,6 +89,10 @@ class MessageCodec:
             return ProposerSlashing.decode(raw)
         if topic == Topic.ATTESTER_SLASHING:
             return ns.AttesterSlashing.decode(raw)
+        if topic == Topic.SYNC_COMMITTEE_MESSAGE:
+            return ns.SyncCommitteeMessage.decode(raw)
+        if topic == Topic.SYNC_CONTRIBUTION:
+            return ns.SignedContributionAndProof.decode(raw)
         raise WireError(f"no codec for topic {topic}")
 
     # -- rpc ---------------------------------------------------------------

@@ -48,6 +48,23 @@ class Router:
                     process_batch=svc.process_gossip_aggregate_batch,
                 )
             )
+        elif topic == Topic.SYNC_COMMITTEE_MESSAGE:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipSyncSignature,
+                    item=message,
+                    process_individual=svc.process_gossip_sync_message,
+                    process_batch=svc.process_gossip_sync_message_batch,
+                )
+            )
+        elif topic == Topic.SYNC_CONTRIBUTION:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipSyncContribution,
+                    item=message,
+                    process_individual=svc.process_gossip_sync_contribution,
+                )
+            )
         elif topic == Topic.VOLUNTARY_EXIT:
             svc.processor.submit(
                 Work(

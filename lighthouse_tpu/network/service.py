@@ -94,6 +94,16 @@ class BeaconNodeService:
             self.node_id, Topic.AGGREGATE_AND_PROOF, signed_aggregate
         )
 
+    def publish_sync_message(self, message) -> None:
+        self.transport.publish(
+            self.node_id, Topic.SYNC_COMMITTEE_MESSAGE, message
+        )
+
+    def publish_contribution(self, signed_contribution) -> None:
+        self.transport.publish(
+            self.node_id, Topic.SYNC_CONTRIBUTION, signed_contribution
+        )
+
     # -- work handlers (network_beacon_processor/gossip_methods.rs) --------
 
     def process_gossip_block(self, item) -> None:
@@ -130,6 +140,15 @@ class BeaconNodeService:
         for sap, verdict in results:
             if not isinstance(verdict, Exception):
                 self.op_pool.insert_attestation(sap.message.aggregate)
+
+    def process_gossip_sync_message(self, msg) -> None:
+        self.process_gossip_sync_message_batch([msg])
+
+    def process_gossip_sync_message_batch(self, msgs) -> None:
+        self.chain.verify_sync_committee_messages(msgs)
+
+    def process_gossip_sync_contribution(self, sc) -> None:
+        self.chain.verify_sync_contributions([sc])
 
     def process_gossip_exit(self, exit_msg) -> None:
         self.op_pool.insert_voluntary_exit(exit_msg)

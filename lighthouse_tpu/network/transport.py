@@ -20,6 +20,8 @@ class Topic:
     VOLUNTARY_EXIT = "voluntary_exit"
     PROPOSER_SLASHING = "proposer_slashing"
     ATTESTER_SLASHING = "attester_slashing"
+    SYNC_COMMITTEE_MESSAGE = "sync_committee"  # subnet topics collapse to one
+    SYNC_CONTRIBUTION = "sync_committee_contribution_and_proof"
 
 
 @dataclass

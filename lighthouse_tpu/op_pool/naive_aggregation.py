@@ -40,8 +40,10 @@ class NaiveAggregationPool:
         return True
 
     def get(self, data) -> "object | None":
-        root = type(data).hash_tree_root(data)
-        entry = self._maps.get(root)
+        return self.get_by_root(type(data).hash_tree_root(data))
+
+    def get_by_root(self, root: bytes) -> "object | None":
+        entry = self._maps.get(bytes(root))
         if entry is None:
             return None
         d, bits, sig = entry

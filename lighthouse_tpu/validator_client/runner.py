@@ -18,6 +18,7 @@ from ..api_client import BeaconNodeHttpClient
 from ..state_transition.genesis import interop_secret_keys
 from ..utils.logging import get_logger
 from .services import (
+    AggregationService,
     AttestationService,
     BlockService,
     DutiesService,
@@ -115,6 +116,9 @@ class ProductionValidatorClient:
         self.attestations = AttestationService(self.ctx, self.duties)
         self.blocks = BlockService(self.ctx, self.duties)
         self.sync_committee = SyncCommitteeService(self.ctx, self.duties)
+        self.aggregation = AggregationService(
+            self.ctx, self.duties, self.attestations
+        )
         g = self.ctx.genesis
         self.client.pin_genesis(g.genesis_validators_root)
         self.client.update_all_candidates()
@@ -144,10 +148,11 @@ class ProductionValidatorClient:
             self._last_duties_epoch = epoch
         proposed = self.blocks.propose(slot)
         attested = self.attestations.attest(slot)
+        aggregated = self.aggregation.aggregate(slot)
         synced = self.sync_committee.sign_and_publish(slot)
         return {
             "slot": slot, "proposed": proposed, "attested": attested,
-            "sync_signed": synced,
+            "aggregated": aggregated, "sync_signed": synced,
         }
 
     def run(self, genesis_time: int | None = None) -> None:

@@ -98,10 +98,13 @@ class AttestationService:
     def __init__(self, ctx: ValidatorClientContext, duties: DutiesService):
         self.ctx = ctx
         self.duties = duties
+        # (slot, committee_index) -> AttestationData, shared with aggregation
+        self.data_cache: dict = {}
 
     def attest(self, slot: int) -> int:
         """Sign + publish one attestation per owned attester duty at slot.
-        Returns the number published."""
+        Returns the number published. The fetched AttestationData is cached
+        per (slot, committee) for the aggregation phase."""
         spec = self.ctx.store.spec
         epoch = slot // spec.preset.SLOTS_PER_EPOCH
         my = self.duties.attesters_at(slot, epoch)
@@ -114,6 +117,11 @@ class AttestationService:
             data = AttestationData.decode(
                 self.ctx.client.get_attestation_data(slot, duty.committee_index)
             )
+            self.data_cache[(slot, duty.committee_index)] = data
+            if len(self.data_cache) > 256:
+                self.data_cache = {
+                    k: v for k, v in self.data_cache.items() if k[0] >= slot - 2
+                }
             try:
                 sig = self.ctx.store.sign_attestation(
                     duty.pubkey, data, fork_info
@@ -130,6 +138,96 @@ class AttestationService:
             published.append(ns.Attestation.encode(att))
         if published:
             self.ctx.client.publish_attestations(published)
+        return len(published)
+
+
+class AggregationService:
+    """The aggregation phase of attestation duties
+    (attestation_service.rs:231-507 second half): a validator whose selection
+    proof selects it as the committee aggregator fetches the naive pool's
+    aggregate from the BN, wraps it in a SignedAggregateAndProof, and
+    publishes it through the 3-sets verification endpoint."""
+
+    def __init__(self, ctx: ValidatorClientContext, duties: DutiesService,
+                 attestations: "AttestationService | None" = None):
+        self.ctx = ctx
+        self.duties = duties
+        self.attestations = attestations
+
+    @staticmethod
+    def is_aggregator(committee_length: int, target_per_committee: int,
+                      selection_proof: bytes) -> bool:
+        """spec is_aggregator: hash(proof) mod ceil-ish committee/TARGET."""
+        import hashlib
+
+        modulo = max(1, committee_length // target_per_committee)
+        digest = hashlib.sha256(bytes(selection_proof)).digest()
+        return int.from_bytes(digest[0:8], "little") % modulo == 0
+
+    def aggregate(self, slot: int) -> int:
+        """Run after attest(slot): publish one SignedAggregateAndProof per
+        owned aggregator duty. Returns the number published."""
+        spec = self.ctx.store.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        my = self.duties.attesters_at(slot, epoch)
+        if not my:
+            return 0
+        fork_info = self.ctx.fork_info()
+        ns = for_preset(spec.preset.name)
+        published = []
+        seen_committees = set()
+        for duty in my:
+            if duty.committee_index in seen_committees:
+                continue
+            try:
+                proof = self.ctx.store.sign_selection_proof(
+                    duty.pubkey, slot, fork_info
+                )
+            except NotSafe:
+                continue
+            if not self.is_aggregator(
+                duty.committee_length,
+                spec.target_aggregators_per_committee,
+                proof.serialize(),
+            ):
+                continue
+            data = None
+            if self.attestations is not None:
+                data = self.attestations.data_cache.get(
+                    (slot, duty.committee_index)
+                )
+            if data is None:
+                data = AttestationData.decode(
+                    self.ctx.client.get_attestation_data(
+                        slot, duty.committee_index
+                    )
+                )
+            from ..api_client import ApiClientError
+
+            try:
+                agg_ssz = self.ctx.client.get_aggregate_attestation(
+                    AttestationData.hash_tree_root(data)
+                )
+            except ApiClientError as e:
+                if e.code != 404:
+                    raise  # outages must not masquerade as 'nothing pooled'
+                continue
+            aggregate = ns.Attestation.decode(agg_ssz)
+            aap = ns.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=aggregate,
+                selection_proof=proof.serialize(),
+            )
+            sig = self.ctx.store.sign_aggregate_and_proof(
+                duty.pubkey, aap, fork_info
+            )
+            sap = ns.SignedAggregateAndProof(
+                message=aap, signature=sig.serialize()
+            )
+            published.append(ns.SignedAggregateAndProof.encode(sap))
+            seen_committees.add(duty.committee_index)
+        if published:
+            self.ctx.client.publish_aggregate_and_proofs(published)
         return len(published)
 
 
