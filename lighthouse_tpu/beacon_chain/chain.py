@@ -144,6 +144,10 @@ class BeaconChain:
         # hot path.
         self.block_observers: list = []
         self.attestation_observers: list = []
+        # SSE event bus (beacon_chain/src/events.rs): subscribers are
+        # per-connection queues; emission never blocks the hot path
+        self.event_subscribers: list = []  # list[(topics, queue.Queue)]
+        self._event_lock = threading.Lock()
         # Liveness tracking for doppelganger protection (the reference's
         # ObservedAttesters / ObservedBlockProducers caches feeding
         # /eth/v1/validator/liveness): epoch -> validator indices seen
@@ -171,6 +175,35 @@ class BeaconChain:
         )
         return [int(i) in seen for i in indices]
 
+    def subscribe_events(self, topics) -> "object":
+        import queue as _q
+
+        q = _q.Queue(maxsize=256)
+        with self._event_lock:
+            self.event_subscribers.append((set(topics), q))
+        return q
+
+    def unsubscribe_events(self, q) -> None:
+        with self._event_lock:
+            self.event_subscribers = [
+                (t, qq) for (t, qq) in self.event_subscribers if qq is not q
+            ]
+
+    def _emit_event(self, topic: str, payload_fn) -> None:
+        """``payload_fn`` is called lazily — zero cost with no subscriber."""
+        with self._event_lock:
+            targets = [
+                q for topics, q in self.event_subscribers if topic in topics
+            ]
+        if not targets:
+            return
+        payload = payload_fn()
+        for q in targets:
+            try:
+                q.put_nowait((topic, payload))
+            except Exception:
+                pass  # slow consumer: drop (events are best-effort)
+
     def _notify_block_observers(self, signed_block) -> None:
         blk = signed_block.message
         self._record_liveness(
@@ -183,6 +216,13 @@ class BeaconChain:
                 obs(signed_block)
             except Exception:
                 pass
+        self._emit_event(
+            "block",
+            lambda: {
+                "slot": str(int(blk.slot)),
+                "block": "0x" + type(blk).hash_tree_root(blk).hex(),
+            },
+        )
 
     def _notify_attestation_observers(self, indexed) -> None:
         self._record_liveness(
@@ -883,6 +923,19 @@ class BeaconChain:
                 self.head = ChainHead(
                     root=head_root, slot=state.slot, state=state
                 )
+                self._emit_event(
+                    "head",
+                    lambda: {
+                        "slot": str(int(state.slot)),
+                        "block": "0x" + head_root.hex(),
+                        # the head block commits to its post-state root —
+                        # no re-merkleization under the chain lock
+                        "state": "0x"
+                        + bytes(
+                            state.latest_block_header.state_root
+                        ).hex(),
+                    },
+                )
         return self.head.root
 
     def _maybe_migrate(self) -> None:
@@ -891,6 +944,13 @@ class BeaconChain:
         fin_slot = self.spec.start_slot(int(fin_epoch))
         if fin_slot > self.migrator.last_finalized_slot and fin_root in self._states:
             self.migrator.process_finalization(self, bytes(fin_root), fin_slot)
+            self._emit_event(
+                "finalized_checkpoint",
+                lambda: {
+                    "epoch": str(int(fin_epoch)),
+                    "block": "0x" + bytes(fin_root).hex(),
+                },
+            )
 
     # -- production -------------------------------------------------------------------
 

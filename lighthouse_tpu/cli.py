@@ -95,6 +95,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated UDP boot-node addresses for peer discovery",
     )
     bn.add_argument(
+        "--validator-monitor-auto", action="store_true",
+        help="monitor every validator (validator_monitor.rs auto mode)",
+    )
+    bn.add_argument(
+        "--validator-monitor-indices", default="",
+        help="comma-separated validator indices to monitor",
+    )
+    bn.add_argument(
         "--checkpoint-sync-url", default=None,
         help="boot from another node's finalized state over HTTP instead of "
              "genesis (client/src/builder.rs checkpoint-sync branch)",
@@ -197,6 +205,10 @@ def run_bn(args) -> "object":
         debug_level=args.debug_level,
         listen_port=args.listen_port,
         boot_nodes=args.boot_nodes,
+        validator_monitor_auto=args.validator_monitor_auto,
+        validator_monitor_indices=tuple(
+            int(x) for x in args.validator_monitor_indices.split(",") if x
+        ),
     )
     builder = ClientBuilder(spec, cfg)
     if args.checkpoint_sync_url:

@@ -32,6 +32,8 @@ class ClientConfig:
     metrics_enabled: bool = False
     metrics_port: int = 0
     slasher_enabled: bool = False
+    validator_monitor_auto: bool = False
+    validator_monitor_indices: tuple = ()
     interop_validators: int = 16
     genesis_time: int | None = None  # None = now
     debug_level: str = "info"
@@ -269,7 +271,9 @@ class ClientBuilder:
         if cfg.metrics_enabled:
             from ..http_metrics import MetricsServer
 
-            metrics_server = MetricsServer(port=cfg.metrics_port)
+            metrics_server = MetricsServer(
+                port=cfg.metrics_port, datadir=cfg.datadir
+            )
 
         slasher_service = None
         if cfg.slasher_enabled:
@@ -281,6 +285,14 @@ class ClientBuilder:
             chain.block_observers.append(slasher_service.block_observed)
             chain.attestation_observers.append(
                 slasher_service.attestation_observed
+            )
+
+        if cfg.validator_monitor_auto or cfg.validator_monitor_indices:
+            from ..beacon_chain.validator_monitor import ValidatorMonitor
+
+            chain.validator_monitor = ValidatorMonitor(
+                chain, indices=cfg.validator_monitor_indices,
+                auto=cfg.validator_monitor_auto,
             )
 
         notifier = Notifier(chain)

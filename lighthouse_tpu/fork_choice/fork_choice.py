@@ -181,6 +181,47 @@ class ForkChoice:
 
     # -- head (fork_choice.rs:474) ----------------------------------------------
 
+    def get_proposer_head(
+        self,
+        current_slot: int,
+        canonical_head: bytes,
+        re_org_threshold_pct: int = 20,
+    ) -> bytes:
+        """Proposer re-org heuristic (fork_choice.rs:522 get_proposer_head):
+        when the head block arrived one slot late and carries little attesting
+        weight, the proposer builds on its PARENT instead, orphaning the weak
+        block. Conservative gate set:
+
+          * the head is exactly one slot behind the proposal slot and its
+            parent is exactly one slot behind the head (no skipped slots),
+          * head weight < re_org_threshold_pct of one slot's committee weight,
+          * finalization is recent (within two epochs),
+          * only a single re-org step (parent must be canonical).
+        Returns the root to build on (parent for a re-org, else the head)."""
+        idx = self.proto.indices.get(bytes(canonical_head))
+        if idx is None:
+            return canonical_head
+        node = self.proto.nodes[idx]
+        if node.parent is None:
+            return canonical_head
+        parent = self.proto.nodes[node.parent]
+        if int(node.slot) + 1 != current_slot:
+            return canonical_head  # head is on time (or older than one slot)
+        if int(parent.slot) + 1 != int(node.slot):
+            return canonical_head  # skipped slot below the head: do not re-org
+        f_epoch, _ = self.store.finalized_checkpoint
+        epochs_since_final = (
+            current_slot // self.spec.preset.SLOTS_PER_EPOCH - int(f_epoch)
+        )
+        if epochs_since_final > 2:
+            return canonical_head  # unhealthy chain: never re-org
+        total = int(self.store.justified_balances.sum())
+        committee_weight = total // self.spec.preset.SLOTS_PER_EPOCH
+        threshold = committee_weight * re_org_threshold_pct // 100
+        if int(node.weight) >= threshold:
+            return canonical_head  # the late block gathered real support
+        return parent.root
+
     def get_head(self, current_slot: int) -> bytes:
         self.update_time(current_slot)
         j_epoch, j_root = self.store.justified_checkpoint

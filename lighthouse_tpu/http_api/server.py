@@ -473,7 +473,17 @@ class BeaconApiServer:
 
 
 def _advanced(chain, slot):
-    state = chain.head.state
+    # one head snapshot: a concurrent import swaps chain.head atomically and
+    # mixing two views would mistake the swap for a re-org decision
+    head = chain.head
+    # proposer re-org heuristic: a weak, late head may be orphaned by
+    # building on its parent (fork_choice get_proposer_head)
+    base_root = chain.fork_choice.get_proposer_head(slot, head.root)
+    if base_root != head.root:
+        parent_state = chain.state_by_root(bytes(base_root))
+        state = parent_state if parent_state is not None else head.state
+    else:
+        state = head.state
     if state.slot < slot:
         state = state.copy()
         process_slots(chain.spec, state, slot)
@@ -502,6 +512,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/eth/v1/beacon/blocks$"), "publish_block"),
     ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_atts"),
     ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
+    ("GET", re.compile(r"^/eth/v1/events$"), "events"),
     ("POST", re.compile(r"^/eth/v1/validator/liveness/(\d+)$"), "liveness"),
     ("POST", re.compile(r"^/eth/v1/validator/duties/sync/(\d+)$"), "sync_duties"),
     ("POST", re.compile(r"^/eth/v1/beacon/pool/sync_committees$"), "publish_sync"),
@@ -538,6 +549,34 @@ def _make_handler(api: BeaconApiServer):
             raw = self.rfile.read(n) if n else b"{}"
             return json.loads(raw.decode() or "{}")
 
+        def _stream_events(self, topics) -> None:
+            """SSE stream (events.rs + eventsource): holds the connection
+            and relays the chain's event bus until the client goes away."""
+            import queue as _q
+
+            sub = api.chain.subscribe_events(topics)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                while True:
+                    try:
+                        topic, payload = sub.get(timeout=1.0)
+                    except _q.Empty:
+                        self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+                        continue
+                    chunk = (
+                        f"event: {topic}\ndata: {json.dumps(payload)}\n\n"
+                    ).encode()
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                api.chain.unsubscribe_events(sub)
+
         def _dispatch(self, method: str) -> None:
             from urllib.parse import parse_qs, urlparse
 
@@ -550,6 +589,12 @@ def _make_handler(api: BeaconApiServer):
                     if not match:
                         continue
                     q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                    if name == "events":
+                        topics = [
+                            t for t in q.get("topics", "head").split(",") if t
+                        ]
+                        self._stream_events(topics)
+                        return
                     if name in _MUTATING:
                         # Only mutation routes serialize on the chain lock;
                         # reads work from the atomically-swapped head snapshot

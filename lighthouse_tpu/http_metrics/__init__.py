@@ -12,8 +12,10 @@ from ..utils.metrics import REGISTRY
 
 
 class MetricsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, registry=None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, registry=None,
+                 datadir: str | None = None):
         self.registry = registry or REGISTRY
+        self.datadir = datadir
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -28,7 +30,13 @@ class MetricsServer:
                         "Content-Type", "text/plain; version=0.0.4"
                     )
                 elif self.path == "/health":
-                    body = b'{"status":"ok"}'
+                    import json
+
+                    from ..utils.system_health import system_health
+
+                    payload = {"status": "ok"}
+                    payload.update(system_health(server.datadir))
+                    body = json.dumps(payload).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                 else:

@@ -1,0 +1,30 @@
+"""Host health snapshot (ref common/system_health): process + system stats
+for the /health surface and the monitoring push."""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+def system_health(datadir: str | None = None) -> dict:
+    out: dict = {"pid": os.getpid()}
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        out["rss_bytes"] = pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError):
+        pass
+    try:
+        load1, load5, load15 = os.getloadavg()
+        out["loadavg"] = [round(load1, 2), round(load5, 2), round(load15, 2)]
+    except OSError:
+        pass
+    out["cpu_count"] = os.cpu_count()
+    try:
+        usage = shutil.disk_usage(datadir or "/")
+        out["disk_total_bytes"] = usage.total
+        out["disk_free_bytes"] = usage.free
+    except OSError:
+        pass
+    return out

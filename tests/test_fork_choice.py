@@ -156,3 +156,37 @@ class TestForkChoiceWrapper:
         assert len(fc.queued_attestations) == 1
         assert fc.get_head(2) == R(1)
         assert len(fc.queued_attestations) == 0
+
+
+def test_get_proposer_head_reorgs_weak_late_block():
+    """fork_choice.rs:522 heuristic: a one-slot-late head with trivial weight
+    is skipped in favor of its parent; a supported head is kept."""
+    import numpy as np
+
+    from lighthouse_tpu.fork_choice import ForkChoice
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    balances = np.full(64, 32 * 10**9, dtype=np.uint64)
+    anchor = b"\x10" * 32
+    fc = ForkChoice.from_anchor(spec, anchor, 0, (0, anchor), (0, anchor), balances)
+
+    def add(root, slot, parent):
+        fc.proto.on_block(
+            root=root, slot=slot, parent_root=parent,
+            state_root=root, target_root=parent,
+            justified_epoch=0, finalized_epoch=0,
+        )
+
+    add(b"\x11" * 32, 1, anchor)
+    add(b"\x12" * 32, 2, b"\x11" * 32)  # the late, unattested head
+
+    # proposing at slot 3 with a weightless head at slot 2 -> build on parent
+    assert fc.get_proposer_head(3, b"\x12" * 32) == b"\x11" * 32
+    # same head but proposing later (slot 4): no re-org (not one-slot-late)
+    assert fc.get_proposer_head(4, b"\x12" * 32) == b"\x12" * 32
+
+    # give the head real weight (> 20% of one slot's committee weight)
+    idx = fc.proto.indices[b"\x12" * 32]
+    fc.proto.nodes[idx].weight = int(balances.sum())
+    assert fc.get_proposer_head(3, b"\x12" * 32) == b"\x12" * 32

@@ -1,0 +1,96 @@
+"""Observability: SSE event stream, validator monitor, system health.
+
+Refs: beacon_chain/src/events.rs + http_api SSE, validator_monitor.rs,
+common/system_health.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client.runner import ProductionValidatorClient
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def test_sse_stream_and_monitor_and_health():
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    clock = ManualSlotClock(0)
+    cfg = ClientConfig(
+        interop_validators=16, genesis_time=0, use_system_clock=False,
+        metrics_enabled=True, validator_monitor_auto=True,
+    )
+    client = (
+        ClientBuilder(spec, cfg).interop_genesis().slot_clock(clock)
+        .build().start()
+    )
+    try:
+        # SSE consumer on its own thread
+        events = []
+        done = threading.Event()
+
+        def consume():
+            req = urllib.request.Request(
+                client.http_server.url
+                + "/eth/v1/events?topics=head,block,finalized_checkpoint"
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                current = {}
+                while not done.is_set():
+                    line = resp.readline().decode().strip()
+                    if line.startswith("event:"):
+                        current["event"] = line.split(":", 1)[1].strip()
+                    elif line.startswith("data:"):
+                        current["data"] = json.loads(line.split(":", 1)[1])
+                        events.append(dict(current))
+                        if len(events) >= 6:
+                            return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+
+        vc = ProductionValidatorClient(spec, client.http_server.url)
+        vc.load_interop_keys(16)
+        vc.connect()
+        spe = spec.preset.SLOTS_PER_EPOCH
+        for slot in range(1, 2 * spe):
+            clock.set_slot(slot)
+            vc.run_slot(slot)
+        t.join(timeout=10)
+        done.set()
+        kinds = {e["event"] for e in events}
+        assert "block" in kinds and "head" in kinds, events[:4]
+        blk = next(e for e in events if e["event"] == "block")
+        assert blk["data"]["block"].startswith("0x")
+
+        # validator monitor tracked attestations + proposals
+        mon = client.chain.validator_monitor
+        summary = mon.epoch_summary(0)
+        assert summary["attestations"] > 0
+        assert summary["blocks"] > 0
+        rec_found = any(
+            mon.validator_record(0, i) for i in range(16)
+        )
+        assert rec_found
+
+        # /health carries system stats
+        health = json.load(
+            urllib.request.urlopen(client.metrics_server.url + "/health")
+        )
+        assert health["status"] == "ok"
+        assert health.get("rss_bytes", 0) > 0
+        assert "cpu_count" in health
+    finally:
+        client.stop()
