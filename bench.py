@@ -173,15 +173,28 @@ def _bench_native(pks_raw, idx, msgs, sigs) -> float:
 
 
 def main():
+    global N_SETS, KEYS_PER_SET, N_VALIDATORS, BATCH, _FIXTURE
     if not _probe_accelerator():
         # device init is wedged (e.g. a stuck tunnel): pin CPU BEFORE any jax
-        # import in this process and say so on stderr
+        # import in this process and say so on stderr. The mainnet shape is
+        # hours of CPU work, so unless shapes were pinned explicitly, shrink
+        # them — an honest small number beats a timeout recording nothing.
         print(
             "# accelerator probe hung; falling back to CPU", file=sys.stderr
         )
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        if "BENCH_SETS" not in os.environ:
+            N_SETS, KEYS_PER_SET, N_VALIDATORS, BATCH = 16, 64, 2048, 8
+            _FIXTURE = os.path.join(
+                _CACHE_DIR,
+                f"fixture_v{N_VALIDATORS}_s{N_SETS}_k{KEYS_PER_SET}.npz",
+            )
+            print(
+                f"# cpu-fallback shape: {N_SETS} sets x {KEYS_PER_SET} keys",
+                file=sys.stderr,
+            )
     pks_comp, pks_raw, idx, msgs, sigs = _fixture()
     native = _bench_native(pks_raw, idx, msgs, sigs)
     print(f"# native (C++ single-core): {native:.2f} sets/s", flush=True)

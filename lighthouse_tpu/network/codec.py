@@ -75,7 +75,10 @@ class MessageCodec:
         return zlib.compress(raw)
 
     def decode_gossip(self, topic: str, data: bytes):
-        raw = zlib.decompress(data)
+        try:
+            raw = zlib.decompress(data)
+        except zlib.error as e:
+            raise WireError(f"bad compression: {e}") from None
         ns = self.ns
         if topic == Topic.BEACON_BLOCK:
             return self._dec_block(raw)
@@ -117,7 +120,10 @@ class MessageCodec:
         return zlib.compress(raw)
 
     def decode_request(self, method: str, data: bytes):
-        raw = zlib.decompress(data)
+        try:
+            raw = zlib.decompress(data)
+        except zlib.error as e:
+            raise WireError(f"bad compression: {e}") from None
         if method == "status":
             return Status(
                 fork_digest=raw[0:4],

@@ -38,12 +38,28 @@ _MAX_FRAME = 1 << 28
 _SEEN_CAP = 4096  # gossipsub duplicate-cache size
 
 
+# Peer-score weights (the gossipsub peer_score.rs shape at its smallest):
+# negative events push a peer toward the ban threshold; useful deliveries
+# claw back slowly. Scores decay toward zero so old sins expire.
+SCORE_MALFORMED = -50.0     # undecodable frame / codec error
+SCORE_HANDLER_ERROR = -10.0  # message that made the service raise
+SCORE_DUPLICATE = -0.5       # redundant gossip (mesh noise)
+SCORE_DELIVERY = 1.0         # first delivery of a message
+SCORE_BAN_THRESHOLD = -100.0
+SCORE_DECAY = 0.9            # per decay interval
+
+
 class _Peer:
     def __init__(self, sock: socket.socket, addr: str):
         self.sock = sock
         self.addr = addr  # canonical "host:port" listen address
         self.send_lock = threading.Lock()
         self.alive = True
+        self.score = 0.0
+
+    def adjust_score(self, delta: float) -> float:
+        self.score = max(-1000.0, min(100.0, self.score + delta))
+        return self.score
 
     def send_frame(self, kind: int, body: bytes) -> None:
         frame = struct.pack(">IB", len(body) + 1, kind) + body
@@ -87,6 +103,16 @@ class SocketTransport(Transport):
     def peers(self, exclude: str | None = None) -> list[str]:
         with self._lock:
             return [a for a in self._peers if a != exclude]
+
+    def peer_scores(self) -> dict[str, float]:
+        with self._lock:
+            return {a: round(p.score, 2) for a, p in self._peers.items()}
+
+    def decay_scores(self) -> None:
+        """Periodic score decay toward zero (peer_score.rs decay interval)."""
+        with self._lock:
+            for p in self._peers.values():
+                p.score *= SCORE_DECAY
 
     def publish(self, from_peer: str, topic: str, message) -> None:
         payload = self.codec.encode_gossip(topic, message)
@@ -253,11 +279,17 @@ class SocketTransport(Transport):
                 try:
                     self._handle_frame(peer, kind, body)
                 except WireError as e:
-                    self._drop_peer(peer, f"codec: {e}")
-                    return
+                    if peer.adjust_score(SCORE_MALFORMED) <= SCORE_BAN_THRESHOLD:
+                        self._drop_peer(peer, f"banned (codec: {e})")
+                        return
+                    log.warn("Malformed frame", addr=peer.addr, error=str(e),
+                             score=round(peer.score, 1))
                 except Exception as e:  # noqa: BLE001 — protocol boundary
-                    self._drop_peer(peer, f"handler: {e}")
-                    return
+                    if peer.adjust_score(SCORE_HANDLER_ERROR) <= SCORE_BAN_THRESHOLD:
+                        self._drop_peer(peer, f"banned (handler: {e})")
+                        return
+                    log.warn("Peer message failed", addr=peer.addr,
+                             error=str(e), score=round(peer.score, 1))
 
     def _handle_frame(self, peer: _Peer, kind: int, body: bytes) -> None:
         if kind == _HELLO:
@@ -289,7 +321,9 @@ class SocketTransport(Transport):
             msg_id = body[1 + tn : 21 + tn]
             payload = body[21 + tn :]
             if not self._mark_seen(msg_id):
+                peer.adjust_score(SCORE_DUPLICATE)
                 return
+            peer.adjust_score(SCORE_DELIVERY)
             # forward FIRST (gossip latency), then process locally
             self._flood(body, except_addr=peer.addr)
             if self._service is not None:

@@ -217,3 +217,55 @@ def test_client_builder_p2p_gossip():
         a.stop()
         b.stop()
         boot.stop()
+
+
+def test_peer_scoring_bans_malformed_sender():
+    """Repeated malformed gossip drags a peer's score below the ban
+    threshold and disconnects it; a single bad frame only penalizes
+    (peer_score.rs semantics at their smallest)."""
+    import struct
+
+    from lighthouse_tpu.network import socket_transport as st
+
+    spec = minimal_spec()
+    a = SocketTransport(spec)
+    b = SocketTransport(spec)
+
+    class Svc:
+        def on_gossip(self, *args):
+            pass
+
+        def on_rpc(self, *a):
+            return None
+
+    try:
+        a.register(a.local_addr, Svc())
+        b.register(b.local_addr, Svc())
+        assert a.dial(b.local_addr)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and not b.peers():
+            time.sleep(0.01)
+        # garbage gossip frames (unique msg ids — duplicates short-circuit)
+        peer = a._peers[b.local_addr]
+
+        def bad_frame(i):
+            return bytes([7]) + b"unknown" + bytes([i]) * 20 + b"garbage"
+
+        per_bad = -st.SCORE_MALFORMED - st.SCORE_DELIVERY
+        n_bad = int(-st.SCORE_BAN_THRESHOLD // per_bad) + 1
+        peer.send_frame(0, bad_frame(1))  # penalized, not banned
+        time.sleep(0.2)
+        scores = b.peer_scores()
+        assert scores and min(scores.values()) <= st.SCORE_MALFORMED / 2
+        for i in range(2, 2 + n_bad):
+            peer.send_frame(0, bad_frame(i))
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and b.peers():
+            time.sleep(0.01)
+        assert not b.peers()  # banned + disconnected
+        # decay pulls scores toward zero
+        a._peers.clear()
+        assert a.peer_scores() == {}
+    finally:
+        a.stop()
+        b.stop()
