@@ -236,9 +236,9 @@ class ClientBuilder:
 
         network_service = None
         if cfg.listen_port is not None:
-            from ..network import BeaconNodeService, SocketTransport
+            from ..network import BeaconNodeService, GossipsubTransport
 
-            transport = SocketTransport(self.spec, port=cfg.listen_port)
+            transport = GossipsubTransport(self.spec, port=cfg.listen_port)
             network_service = BeaconNodeService(
                 transport.local_addr, self.spec, transport=transport,
                 chain=chain, op_pool=op_pool,

@@ -17,6 +17,7 @@ sync with batched epochs (``network/src/sync/manager.rs``,
 
 from .boot_node import BootNode  # noqa: F401
 from .codec import MessageCodec, WireError  # noqa: F401
+from .gossipsub import GossipsubParams, GossipsubTransport  # noqa: F401
 from .router import Router  # noqa: F401
 from .service import BeaconNodeService  # noqa: F401
 from .socket_transport import SocketTransport  # noqa: F401

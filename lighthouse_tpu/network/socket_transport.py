@@ -114,14 +114,18 @@ class SocketTransport(Transport):
             for p in self._peers.values():
                 p.score *= SCORE_DECAY
 
-    def publish(self, from_peer: str, topic: str, message) -> None:
+    def _gossip_body(self, topic: str, message) -> tuple[bytes, bytes]:
+        """Encode a gossip message into (msg_id, wire body). The single
+        definition of message identity: sha256(topic || payload)[:20]."""
         payload = self.codec.encode_gossip(topic, message)
         msg_id = hashlib.sha256(topic.encode() + payload).digest()[:20]
+        tb = topic.encode()
+        return msg_id, bytes([len(tb)]) + tb + msg_id + payload
+
+    def publish(self, from_peer: str, topic: str, message) -> None:
+        msg_id, body = self._gossip_body(topic, message)
         self._mark_seen(msg_id)
         self.published += 1
-        body = (
-            bytes([len(topic)]) + topic.encode() + msg_id + payload
-        )
         self._flood(body, except_addr=None)
 
     def request(self, from_peer: str, to_peer: str, method: str, payload):
@@ -160,6 +164,9 @@ class SocketTransport(Transport):
         host, port = addr.rsplit(":", 1)
         try:
             s = socket.create_connection((host, int(port)), timeout=5)
+            # the connect timeout must not linger: a timed-out socket raises
+            # on recv after 5 IDLE seconds, silently killing quiet peers
+            s.settimeout(None)
         except OSError as e:
             log.warn("Dial failed", addr=addr, error=str(e))
             return False

@@ -63,11 +63,11 @@ class LocalNetwork:
             import time as _time
 
             from ..network.boot_node import BootNode
-            from ..network.socket_transport import SocketTransport
+            from ..network.gossipsub import GossipsubTransport
 
             self.boot = BootNode().start()
             for i in range(n_nodes):
-                t = SocketTransport(spec)
+                t = GossipsubTransport(spec)
                 svc = BeaconNodeService(
                     t.local_addr,
                     spec,

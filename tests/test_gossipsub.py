@@ -1,0 +1,283 @@
+"""Gossipsub v1.1 mesh: degree bounds, O(D) load, IHAVE/IWANT, scoring.
+
+Refs: lighthouse_network/gossipsub/src/behaviour.rs (mesh maintenance,
+GRAFT/PRUNE, IHAVE/IWANT), peer_score.rs (per-topic scoring + graylist).
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.network.gossipsub import (
+    GossipsubParams,
+    GossipsubTransport,
+)
+from lighthouse_tpu.network.transport import Topic
+from lighthouse_tpu.types.spec import minimal_spec
+
+TOPIC = Topic.BEACON_ATTESTATION
+
+
+def _att(ns, root=b"\x77" * 32, slot=1):
+    import numpy as np
+
+    from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+
+    return ns.Attestation(
+        aggregation_bits=np.zeros(4, dtype=bool),
+        data=AttestationData(
+            slot=slot, index=0, beacon_block_root=root,
+            source=Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=Checkpoint(epoch=0, root=b"\x00" * 32),
+        ),
+        signature=b"\xc0" + b"\x00" * 95,
+    )
+
+
+class RecordingSvc:
+    def __init__(self):
+        self.seen = []
+
+    def on_gossip(self, topic, message, from_peer):
+        self.seen.append((topic, bytes(message.data.beacon_block_root)))
+
+    def on_rpc(self, *a):
+        raise AssertionError("no rpc expected")
+
+
+class RejectingSvc(RecordingSvc):
+    """Service that rejects every message (validation failure path)."""
+
+    def on_gossip(self, topic, message, from_peer):
+        raise ValueError("invalid message")
+
+
+def _mk_net(n, params, svc_cls=RecordingSvc):
+    spec = minimal_spec()
+    ts, svcs = [], []
+    for _ in range(n):
+        t = GossipsubTransport(
+            spec, params=params, run_heartbeat=False, topics=[TOPIC]
+        )
+        svc = svc_cls()
+        t.register(t.local_addr, svc)
+        ts.append(t)
+        svcs.append(svc)
+    # full connectivity: everyone dials everyone
+    for i in range(n):
+        for j in range(i + 1, n):
+            ts[i].dial(ts[j].local_addr)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and any(
+        len(t.peers()) < n - 1 for t in ts
+    ):
+        time.sleep(0.01)
+    assert all(len(t.peers()) == n - 1 for t in ts)
+    time.sleep(0.1)  # SUBSCRIBE control frames land
+    return ts, svcs
+
+
+def _heartbeats(ts, rounds=3, settle=0.15):
+    for _ in range(rounds):
+        for t in ts:
+            t.heartbeat()
+        time.sleep(settle)  # GRAFT/PRUNE responses land
+
+
+def _stop(ts):
+    for t in ts:
+        t.stop()
+
+
+def test_mesh_degree_bounds():
+    """After heartbeats, every node's mesh degree sits in [d_lo, d_hi] even
+    though 8 peers are connected (behaviour.rs heartbeat maintenance)."""
+    p = GossipsubParams(d=3, d_lo=2, d_hi=4, d_lazy=2)
+    ts, _ = _mk_net(9, p)
+    try:
+        _heartbeats(ts, rounds=4)
+        for t in ts:
+            deg = len(t.mesh_peers(TOPIC))
+            assert p.d_lo <= deg <= p.d_hi, (t.local_addr, deg)
+            # mesh is a strict subset of the connected peers
+            assert deg < len(t.peers())
+    finally:
+        _stop(ts)
+
+
+def test_mesh_load_is_O_D_not_O_peers():
+    """Per-node gossip receptions stay near the mesh degree, far below the
+    flood cost (peers-1), while every node still gets every message."""
+    from lighthouse_tpu.types.containers import for_preset
+
+    n = 9
+    p = GossipsubParams(d=3, d_lo=2, d_hi=4, d_lazy=1)
+    ts, svcs = _mk_net(n, p)
+    ns = for_preset("minimal")
+    try:
+        _heartbeats(ts, rounds=4)
+        base_rx = [t.gossip_rx for t in ts]
+        n_msgs = 12
+        for k in range(n_msgs):
+            src = ts[k % n]
+            src.publish(
+                src.local_addr, TOPIC, _att(ns, root=bytes([k]) * 32)
+            )
+            time.sleep(0.05)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
+            len(s.seen) < n_msgs - 1 for s in svcs
+        ):
+            time.sleep(0.02)
+        # completeness: every node sees every message it didn't publish
+        for i, s in enumerate(svcs):
+            assert len(s.seen) >= n_msgs - 2, (i, len(s.seen))
+        # load: receptions per message per node bounded by the mesh degree
+        # envelope (d_hi + slack for mesh-forming publishes), NOT n-1 = 8
+        total_rx = sum(t.gossip_rx - b for t, b in zip(ts, base_rx))
+        per_node_per_msg = total_rx / (n * n_msgs)
+        assert per_node_per_msg <= p.d_hi + 1, per_node_per_msg
+        flood_cost = n - 1
+        assert per_node_per_msg < 0.75 * flood_cost, per_node_per_msg
+    finally:
+        _stop(ts)
+
+
+def test_ihave_iwant_recovers_missed_message():
+    """A subscribed peer outside the mesh hears about a message via IHAVE
+    and fetches it with IWANT (behaviour.rs emit_gossip / handle_ihave)."""
+    from lighthouse_tpu.types.containers import for_preset
+
+    p = GossipsubParams(d=1, d_lo=1, d_hi=2, d_lazy=2, prune_backoff=600)
+    ts, svcs = _mk_net(3, p)
+    a, b, c = ts
+    ns = for_preset("minimal")
+    try:
+        _heartbeats(ts, rounds=2)
+        # force C out of everyone's mesh with a long backoff so heartbeats
+        # can't re-graft it: C now only hears via IHAVE
+        now = time.monotonic()
+        for t in (a, b):
+            with t._gs_lock:
+                mesh = t._mesh.get(TOPIC, set())
+                for peer in list(mesh):
+                    if peer.addr == c.local_addr:
+                        mesh.discard(peer)
+                    t._backoff[(TOPIC, c.local_addr)] = now + 600
+        with c._gs_lock:
+            c._mesh.get(TOPIC, set()).clear()
+            c._backoff[(TOPIC, a.local_addr)] = now + 600
+            c._backoff[(TOPIC, b.local_addr)] = now + 600
+        b.publish(b.local_addr, TOPIC, _att(ns, root=b"\x55" * 32))
+        time.sleep(0.1)
+        # A and B exchange the message in-mesh; C hasn't seen it
+        assert svcs[0].seen and not svcs[2].seen
+        # heartbeat emits IHAVE to non-mesh peers; C IWANTs the body
+        _heartbeats(ts, rounds=3, settle=0.2)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and not svcs[2].seen:
+            time.sleep(0.02)
+        assert svcs[2].seen == [(TOPIC, b"\x55" * 32)]
+        assert a.iwant_served + b.iwant_served >= 1
+    finally:
+        _stop(ts)
+
+
+def test_invalid_messages_are_not_forwarded():
+    """v1.1 validation-before-forwarding: a message the service rejects
+    stops at the first hop."""
+    from lighthouse_tpu.types.containers import for_preset
+
+    spec = minimal_spec()
+    p = GossipsubParams(d=2, d_lo=1, d_hi=3)
+    # line: A (publisher, recording) - B (rejecting) - C (recording)
+    a = GossipsubTransport(spec, params=p, run_heartbeat=False, topics=[TOPIC])
+    b = GossipsubTransport(spec, params=p, run_heartbeat=False, topics=[TOPIC])
+    c = GossipsubTransport(spec, params=p, run_heartbeat=False, topics=[TOPIC])
+    sa, sb, sc = RecordingSvc(), RejectingSvc(), RecordingSvc()
+    ns = for_preset("minimal")
+    try:
+        for t, s in ((a, sa), (b, sb), (c, sc)):
+            t.register(t.local_addr, s)
+        assert a.dial(b.local_addr)
+        assert b.dial(c.local_addr)
+        time.sleep(0.15)
+        _heartbeats([a, b, c], rounds=2)
+        a.publish(a.local_addr, TOPIC, _att(ns))
+        time.sleep(0.3)
+        assert sc.seen == []  # B rejected -> no forward to C
+        # B's view of A took an invalid-message penalty
+        scores = b.peer_scores()
+        assert scores.get(a.local_addr, 0) < 0, scores
+    finally:
+        _stop([a, b, c])
+
+
+def test_scoring_prunes_misbehaving_mesh_peer():
+    """A mesh peer that keeps sending invalid messages goes score-negative,
+    is pruned from the mesh at the next heartbeat, and its re-GRAFT is
+    refused while backed off (peer_score.rs + behaviour.rs handle_graft)."""
+    from lighthouse_tpu.types.containers import for_preset
+
+    spec = minimal_spec()
+    # graylist disabled so the test sees the prune + refused-regraft path
+    # (with defaults the peer would be disconnected outright, tested above)
+    p = GossipsubParams(d=2, d_lo=1, d_hi=3, graylist_threshold=-1e9)
+    good = GossipsubTransport(
+        spec, params=p, run_heartbeat=False, topics=[TOPIC]
+    )
+    bad = GossipsubTransport(
+        spec, params=p, run_heartbeat=False, topics=[TOPIC]
+    )
+    svc = RejectingSvc()  # good rejects everything bad sends
+    ns = for_preset("minimal")
+    try:
+        good.register(good.local_addr, svc)
+        bad.register(bad.local_addr, RecordingSvc())
+        assert bad.dial(good.local_addr)
+        time.sleep(0.15)
+        _heartbeats([good, bad], rounds=2)
+        assert good.mesh_peers(TOPIC) == [bad.local_addr]
+        for k in range(3):
+            bad.publish(
+                bad.local_addr, TOPIC, _att(ns, root=bytes([0xA0 + k]) * 32)
+            )
+            time.sleep(0.05)
+        time.sleep(0.2)
+        assert good.peer_scores()[bad.local_addr] < 0
+        good.heartbeat()  # prunes the negative-score mesh peer
+        assert good.mesh_peers(TOPIC) == []
+        # refused re-GRAFT: bad's heartbeat grafts, good prunes it right back
+        bad.heartbeat()
+        time.sleep(0.2)
+        assert good.mesh_peers(TOPIC) == []
+    finally:
+        _stop([good, bad])
+
+
+def test_fanout_publish_without_subscription():
+    """Publishing to a topic we don't subscribe to goes through fanout
+    peers who DO subscribe (behaviour.rs fanout)."""
+    from lighthouse_tpu.types.containers import for_preset
+
+    spec = minimal_spec()
+    p = GossipsubParams(d=2, d_lo=1, d_hi=3)
+    pub = GossipsubTransport(spec, params=p, run_heartbeat=False, topics=[])
+    sub = GossipsubTransport(
+        spec, params=p, run_heartbeat=False, topics=[TOPIC]
+    )
+    s = RecordingSvc()
+    ns = for_preset("minimal")
+    try:
+        pub.register(pub.local_addr, RecordingSvc())
+        sub.register(sub.local_addr, s)
+        assert pub.dial(sub.local_addr)
+        time.sleep(0.15)
+        pub.publish(pub.local_addr, TOPIC, _att(ns, root=b"\x66" * 32))
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and not s.seen:
+            time.sleep(0.02)
+        assert s.seen == [(TOPIC, b"\x66" * 32)]
+        assert TOPIC in pub._fanout
+    finally:
+        _stop([pub, sub])
