@@ -334,20 +334,32 @@ def fq12_cyclotomic_sqr(a, in_bound=PUB_BOUND):
     return plans.execute(plans.CYC_SQR, a, a, in_bound, in_bound, "cyc_sqr")
 
 
+def _repeat_cyc_sqr(a, n: int):
+    if n <= 0:
+        return a
+    if n <= 4:
+        for _ in range(n):
+            a = fq12_cyclotomic_sqr(a)
+        return a
+    return jax.lax.fori_loop(0, n, lambda _, g: fq12_cyclotomic_sqr(g), a)
+
+
 def fq12_cyclotomic_exp_abs_x(a):
-    """a^|x| (|x| = 0xd201000000010000) via scan of cyclotomic squarings."""
-    x_abs = -_of.BLS_X
-    nbits = x_abs.bit_length()
-    bits = jnp.asarray(
-        [(x_abs >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=jnp.uint64
-    )
-
-    def step(res, bit):
-        res = fq12_cyclotomic_sqr(res)
-        res = t_select(bit == 1, fq12_mul(res, a), res)
-        return res, None
-
-    res, _ = jax.lax.scan(step, a, bits[1:])  # MSB consumed by starting at a
+    """a^|x| (|x| = 0xd201000000010000, popcount 6): the exponent is fixed at
+    trace time, so zero bits are squarings only — 63 cyc_sqr + 5 fq12_mul
+    instead of the ladder's 63 x (cyc_sqr + mul + select). Final
+    exponentiation calls this 5 times; it is the hard part's hot loop."""
+    bits = bin(-_of.BLS_X)[2:]
+    res = a
+    i = 1
+    while i < len(bits):
+        j = bits.find("1", i)
+        if j == -1:
+            res = _repeat_cyc_sqr(res, len(bits) - i)
+            break
+        res = _repeat_cyc_sqr(res, j - i + 1)
+        res = fq12_mul(res, a)
+        i = j + 1
     return res
 
 
