@@ -10,10 +10,13 @@ The HTTP JSON-RPC transport for a real client plugs in behind the same
 ``ExecutionEngine`` interface.
 """
 
+from .auth import JwtKey  # noqa: F401
 from .engine import (  # noqa: F401
     ExecutionEngine,
     PayloadAttributes,
     PayloadStatus,
     PayloadStatusV1,
 )
+from .http import EngineApiError, HttpExecutionEngine  # noqa: F401
+from .json_server import ExecutionJsonRpcServer  # noqa: F401
 from .mock import ExecutionBlockGenerator, MockExecutionLayer  # noqa: F401

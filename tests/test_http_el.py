@@ -1,0 +1,223 @@
+"""Engine-API HTTP client + JWT + eth1 HTTP provider.
+
+Refs: execution_layer/src/engine_api/http.rs (JSON-RPC dispatch),
+engine_api/auth.rs (HS256 JWT, iat window), eth1/src/service.rs (eth
+namespace + DepositEvent decoding). The mock EL served over a real local
+socket is the counterparty, so the full wire path is exercised
+(test_utils/mock_execution_layer.rs pattern).
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.chain import BeaconChain, BlockError
+from lighthouse_tpu.execution_layer import (
+    EngineApiError,
+    ExecutionJsonRpcServer,
+    HttpExecutionEngine,
+    JwtKey,
+    MockExecutionLayer,
+    PayloadAttributes,
+    PayloadStatus,
+)
+from lighthouse_tpu.execution_layer.mock import GENESIS_BLOCK_HASH
+from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.containers import Withdrawal, for_preset
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+def _capella_spec():
+    return minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0
+    )
+
+
+# -- JWT ---------------------------------------------------------------------
+
+def test_jwt_roundtrip_and_window():
+    key = JwtKey(b"\x42" * 32)
+    token = key.generate_token()
+    assert key.validate_token(token)
+    # wrong key
+    other = JwtKey(b"\x43" * 32)
+    assert not other.validate_token(token)
+    # stale iat (outside the +-60s window, auth.rs parity)
+    stale = key.generate_token(iat=int(time.time()) - 300)
+    assert not key.validate_token(stale)
+    future = key.generate_token(iat=int(time.time()) + 300)
+    assert not key.validate_token(future)
+    # garbage
+    assert not key.validate_token("not.a.jwt")
+    assert not key.validate_token("")
+
+
+def test_jwt_file_roundtrip(tmp_path):
+    path = str(tmp_path / "jwtsecret")
+    key = JwtKey.generate(path)
+    loaded = JwtKey.from_file(path)
+    assert loaded.secret == key.secret
+    assert loaded.validate_token(key.generate_token())
+    with pytest.raises(ValueError):
+        JwtKey(b"\x00" * 16)
+
+
+# -- engine API over HTTP ----------------------------------------------------
+
+@pytest.fixture()
+def served_mock():
+    ns = for_preset("minimal")
+    key = JwtKey(b"\x07" * 32)
+    mock = MockExecutionLayer()
+    server = ExecutionJsonRpcServer(
+        engine=mock, ns=ns, jwt_key=key
+    ).start()
+    yield server, mock, key, ns
+    server.stop()
+
+
+def test_engine_http_roundtrip(served_mock):
+    server, mock, key, ns = served_mock
+    eng = HttpExecutionEngine(server.url, jwt_key=key)
+    caps = eng.exchange_capabilities()
+    assert "engine_forkchoiceUpdatedV2" in caps
+
+    wd = [Withdrawal(index=0, validator_index=3, address=b"\xaa" * 20, amount=7)]
+    status, payload_id = eng.forkchoice_updated(
+        GENESIS_BLOCK_HASH,
+        b"\x00" * 32,
+        PayloadAttributes(
+            timestamp=12, prev_randao=b"\x01" * 32, withdrawals=wd
+        ),
+    )
+    assert status.status == PayloadStatus.VALID
+    assert payload_id is not None
+
+    payload = eng.get_payload(payload_id, ns.ExecutionPayloadCapella)
+    assert int(payload.block_number) == 1
+    assert bytes(payload.parent_hash) == GENESIS_BLOCK_HASH
+    assert len(payload.withdrawals) == 1
+    assert int(payload.withdrawals[0].amount) == 7
+
+    st = eng.notify_new_payload(payload)
+    assert st.status == PayloadStatus.VALID
+    assert st.latest_valid_hash == bytes(payload.block_hash)
+
+    # tampered payload -> INVALID_BLOCK_HASH through the wire
+    payload.gas_limit = 999
+    st = eng.notify_new_payload(payload)
+    assert st.status == PayloadStatus.INVALID_BLOCK_HASH
+
+
+def test_engine_http_rejects_bad_jwt(served_mock):
+    server, mock, key, ns = served_mock
+    wrong = HttpExecutionEngine(server.url, jwt_key=JwtKey(b"\x08" * 32))
+    with pytest.raises(EngineApiError):
+        wrong.exchange_capabilities()
+    assert server.auth_failures >= 1
+    # no auth header at all
+    naked = HttpExecutionEngine(server.url, jwt_key=None)
+    with pytest.raises(EngineApiError):
+        naked.exchange_capabilities()
+
+
+def test_chain_imports_blocks_through_http_engine():
+    """The existing mock-EL import flow, unchanged, through the HTTP client:
+    chain -> HttpExecutionEngine -> socket -> ExecutionJsonRpcServer -> mock
+    (VERDICT r3 item 4 done-condition)."""
+    spec = _capella_spec()
+    h = StateHarness(spec, 16)
+    ns = for_preset("minimal")
+    key = JwtKey(b"\x09" * 32)
+    server = ExecutionJsonRpcServer(engine=h.el, ns=ns, jwt_key=key).start()
+    try:
+        clock = ManualSlotClock(0)
+        chain = BeaconChain(
+            spec, h.state.copy(), slot_clock=clock,
+            execution_layer=HttpExecutionEngine(server.url, jwt_key=key),
+        )
+        for slot in (1, 2, 3):
+            clock.set_slot(slot)
+            b = h.produce_block(slot)
+            h.apply_block(b)
+            root = chain.process_block(b)
+            node = chain.fork_choice.proto.get_node(root)
+            assert node.execution_status == ExecutionStatus.VALID
+        assert chain.head.slot == 3
+
+        h.el.set_mode("invalid")
+        clock.set_slot(4)
+        bad = h.produce_block(4)
+        with pytest.raises(BlockError, match="execution payload invalid"):
+            chain.process_block(bad)
+        h.el.set_mode("valid")
+    finally:
+        server.stop()
+
+
+# -- eth1 over HTTP ----------------------------------------------------------
+
+def test_http_eth1_provider_blocks_and_deposits():
+    from lighthouse_tpu.eth1.http_provider import HttpEth1Provider
+    from lighthouse_tpu.eth1.provider import MockEth1Provider
+    from lighthouse_tpu.types.containers import DepositData
+
+    mock = MockEth1Provider(genesis_timestamp=1000)
+    server = ExecutionJsonRpcServer(eth1=mock).start()
+    try:
+        http = HttpEth1Provider(server.url)
+        assert http.latest_block_number() == 0
+        for _ in range(3):
+            mock.mine_block()
+        assert http.latest_block_number() == 3
+        blk = http.get_block(2)
+        direct = mock.get_block(2)
+        assert blk.hash == direct.hash
+        assert blk.parent_hash == direct.parent_hash
+        assert blk.timestamp == direct.timestamp
+
+        dd = DepositData(
+            pubkey=b"\xab" * 48,
+            withdrawal_credentials=b"\x00" * 32,
+            amount=32_000_000_000,
+            signature=b"\xcd" * 96,
+        )
+        mock.submit_deposit(dd)
+        logs = http.get_deposit_logs(0, http.latest_block_number())
+        assert len(logs) == 1
+        log = logs[0]
+        assert bytes(log.data.pubkey) == b"\xab" * 48
+        assert int(log.data.amount) == 32_000_000_000
+        assert bytes(log.data.signature) == b"\xcd" * 96
+        assert log.index == 0
+    finally:
+        server.stop()
+
+
+def test_deposit_event_abi_roundtrip():
+    from lighthouse_tpu.eth1.deposit_cache import DepositLog
+    from lighthouse_tpu.eth1.http_provider import (
+        decode_deposit_log,
+        encode_deposit_log,
+    )
+    from lighthouse_tpu.types.containers import DepositData
+
+    log = DepositLog(
+        data=DepositData(
+            pubkey=bytes(range(48)),
+            withdrawal_credentials=b"\x01" * 32,
+            amount=123_456_789,
+            signature=bytes(range(96)),
+        ),
+        block_number=42,
+        index=7,
+    )
+    out = decode_deposit_log(encode_deposit_log(log, b"\x11" * 20))
+    assert bytes(out.data.pubkey) == bytes(range(48))
+    assert bytes(out.data.withdrawal_credentials) == b"\x01" * 32
+    assert int(out.data.amount) == 123_456_789
+    assert bytes(out.data.signature) == bytes(range(96))
+    assert out.block_number == 42
+    assert out.index == 7
