@@ -137,6 +137,10 @@ class BeaconChain:
             root=genesis_root, slot=genesis_state.slot, state=genesis_state
         )
         self._seen_blocks: set[bytes] = {genesis_root}
+        # backfill anchor (historical_blocks.rs): the oldest canonical block
+        # we hold; checkpoint-synced chains fill backwards from here
+        self._oldest_block_slot = int(genesis_state.slot)
+        self._oldest_block_parent = bytes(hdr.parent_root)
         # Ingest seams for auxiliary services (the reference's slasher
         # service subscribes to gossip/import events, service.rs): called
         # with (signed_block) after import / (indexed_attestation) after
@@ -314,6 +318,15 @@ class BeaconChain:
 
     def get_state_for_block(self, parent_root: bytes, slot: int):
         parent_state = self._states.get(parent_root)
+        if parent_state is None and parent_root in self._seen_blocks:
+            # restart path: a known block whose state lives only in the
+            # store (e.g. the restored head) — load and re-cache it
+            try:
+                parent_state = self.state_by_root(parent_root)
+                if parent_state is not None:
+                    self._states[parent_root] = parent_state
+            except Exception:  # noqa: BLE001 — treated as unknown below
+                parent_state = None
         if parent_state is None:
             raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
         state = parent_state.copy()
@@ -440,6 +453,91 @@ class BeaconChain:
             return self._process_chain_segment_locked(
                 blocks, roots, blobs_by_root or {}
             )
+
+    @property
+    def oldest_block_slot(self) -> int:
+        """Slot of the oldest canonical block held (backfill progress)."""
+        return self._oldest_block_slot
+
+    @property
+    def backfill_complete(self) -> bool:
+        return (
+            self._oldest_block_slot <= 1
+            or self._oldest_block_parent == b"\x00" * 32
+        )
+
+    @property
+    def anchor_block_missing(self) -> bool:
+        """Checkpoint boot holds the anchor's header (inside the state) but
+        not the anchor block itself; it must be fetched by root before the
+        chain can serve a gap-free history."""
+        return (
+            self.genesis_block_root not in self._blocks
+            and self._oldest_block_slot > 0
+        )
+
+    def import_anchor_block(self, signed_block) -> None:
+        """Accept the checkpoint anchor block itself. No signature check
+        needed: its root is pinned by the trusted checkpoint state
+        (checkpoint-sync block fetch, client/src/builder.rs)."""
+        with self.lock:
+            root = signed_block.message.tree_root()
+            if root != self.genesis_block_root:
+                raise BlockError("anchor block root mismatch")
+            self._blocks[root] = signed_block
+            self._seen_blocks.add(root)
+            self.store.put_block(root, type(signed_block).encode(signed_block))
+
+    def import_historical_blocks(self, blocks) -> int:
+        """Backwards history fill below the anchor
+        (``beacon_chain/src/historical_blocks.rs``): ``blocks`` are
+        consecutive ascending-slot signed blocks whose LAST element must be
+        the parent of our oldest known block. Linkage is checked as a
+        parent-root hash chain and all proposer signatures are verified in
+        ONE batch against the pubkey cache; no state transition is run —
+        finality already covers these slots. Valid blocks become servable
+        history and move the backfill anchor down."""
+        if not blocks:
+            return 0
+        from ..types.helpers import compute_domain, compute_signing_root
+
+        with self.lock:
+            roots = [sb.message.tree_root() for sb in blocks]
+            if roots[-1] != self._oldest_block_parent:
+                raise BlockError(
+                    "backfill segment does not link to the oldest known block"
+                )
+            for i in range(len(blocks) - 1):
+                if bytes(blocks[i + 1].message.parent_root) != roots[i]:
+                    raise BlockError("backfill segment is not a hash chain")
+            state = self.head.state
+            gvr = bytes(state.genesis_validators_root)
+            items = []
+            for sb in blocks:
+                epoch = self.spec.compute_epoch_at_slot(int(sb.message.slot))
+                # the full fork schedule, not state.fork: backfill spans
+                # arbitrarily many forks below the anchor
+                domain = compute_domain(
+                    self.spec.DOMAIN_BEACON_PROPOSER,
+                    self.spec.fork_version_at_epoch(epoch),
+                    gvr,
+                )
+                items.append(
+                    (
+                        [int(sb.message.proposer_index)],
+                        compute_signing_root(sb.message, domain),
+                        bytes(sb.signature),
+                    )
+                )
+            if not self._batch_verify_items(items):
+                raise BlockError("backfill segment signatures invalid")
+            for sb, root in zip(blocks, roots):
+                self._blocks[root] = sb
+                self._seen_blocks.add(root)
+                self.store.put_block(root, type(sb).encode(sb))
+            self._oldest_block_slot = int(blocks[0].message.slot)
+            self._oldest_block_parent = bytes(blocks[0].message.parent_root)
+            return len(blocks)
 
     def _check_segment_availability(self, sb, block_root, blobs_by_root):
         """Deneb: segment blocks with commitments need their sidecars
@@ -919,6 +1017,13 @@ class BeaconChain:
         self._maybe_migrate()
         if head_root != self.head.root:
             state = self._states.get(head_root)
+            if state is None:
+                # restart path: the restored fork choice can point at a head
+                # whose state lives only in the store (persisted_fork_choice)
+                try:
+                    state = self.state_by_root(head_root)
+                except Exception:  # noqa: BLE001 — keep the old head
+                    state = None
             if state is not None:
                 self.head = ChainHead(
                     root=head_root, slot=state.slot, state=state

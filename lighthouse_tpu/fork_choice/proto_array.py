@@ -73,6 +73,9 @@ class ProtoArrayForkChoice:
         self._old_balances = np.zeros(0, dtype=np.int64)  # last-applied balances
         self._root_ids: dict[bytes, int] = {}
         self._id_roots: list[bytes] = [b"\x00" * 32]  # id 0 = null
+        # memoized descends-from-finalized, invalidated on finalization
+        self._fin_desc_key: bytes | None = None
+        self._fin_desc: dict[int, bool] = {}
         self.on_block(
             slot=finalized_slot,
             root=finalized_root,
@@ -187,6 +190,8 @@ class ProtoArrayForkChoice:
         self.justified_epoch = justified_epoch
         self.finalized_epoch = finalized_epoch
         self.justified_root = justified_root
+        if current_slot is not None:
+            self._current_epoch = current_slot // slots_per_epoch
         deltas = self._compute_deltas(justified_state_balances, equivocating_indices)
         self._apply_score_changes(deltas, proposer_boost_root, proposer_score_boost,
                                   justified_state_balances, slots_per_epoch)
@@ -272,15 +277,57 @@ class ProtoArrayForkChoice:
         return self._node_is_viable_for_head(node)
 
     def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        """Spec ``filter_block_tree`` viability (post-Capella fork choice,
+        mirrored by the reference's ``node_is_viable_for_head``): the node's
+        voting source must match the store's justified checkpoint OR be
+        within the two-epoch grace window (``voting_source.epoch + 2 >=
+        current_epoch`` — what lets descendants of a checkpoint-sync anchor
+        whose own justification lags the invented anchor checkpoint become
+        head), and the node must descend from the finalized checkpoint."""
         if node.execution_status == ExecutionStatus.INVALID:
             return False
         cj = node.unrealized_justified_epoch
-        cf = node.unrealized_finalized_epoch
         j = cj if cj is not None else node.justified_epoch
-        f = cf if cf is not None else node.finalized_epoch
-        ok_j = j == self.justified_epoch or self.justified_epoch == 0
-        ok_f = f == self.finalized_epoch or self.finalized_epoch == 0
+        ok_j = (
+            self.justified_epoch == 0
+            or j == self.justified_epoch
+            or j + 2 >= getattr(self, "_current_epoch", 0)
+        )
+        ok_f = self.finalized_epoch == 0 or self._descends_from_finalized(
+            node
+        )
         return ok_j and ok_f
+
+    def _descends_from_finalized(self, node: ProtoNode) -> bool:
+        """Memoized finalized-ancestry: viability runs per node on the head
+        hot path, so the parent walk amortizes to O(1) per node instead of
+        O(depth) (is_finalized_checkpoint_or_descendant in the reference)."""
+        if self._fin_desc_key != self.finalized_root:
+            self._fin_desc_key = self.finalized_root
+            self._fin_desc = {}
+        fi = self.indices.get(self.finalized_root)
+        if fi is None:
+            return True  # anchor not in the graph: nothing to filter on
+        memo = self._fin_desc
+        fslot = self.nodes[fi].slot
+        path = []
+        i = self.indices.get(node.root)
+        while True:
+            if i is None or self.nodes[i].slot < fslot:
+                res = False
+                break
+            if i == fi:
+                res = True
+                break
+            cached = memo.get(i)
+            if cached is not None:
+                res = cached
+                break
+            path.append(i)
+            i = self.nodes[i].parent
+        for p in path:
+            memo[p] = res
+        return res
 
     def _maybe_update_best_child(self, parent_idx: int, child_idx: int) -> None:
         parent = self.nodes[parent_idx]
@@ -370,3 +417,6 @@ class ProtoArrayForkChoice:
         for i, n in enumerate(self.nodes):
             self.indices[n.root] = i
         self.finalized_root = finalized_root
+        # node indices shifted: the index-keyed ancestry memo is stale
+        self._fin_desc_key = None
+        self._fin_desc = {}

@@ -168,7 +168,10 @@ class GossipsubTransport(SocketTransport):
         return st
 
     def _tscore(self, peer: _Peer, topic: str) -> _TopicScore:
-        return self._ps(peer).topic(topic, self.params.max_peer_topics)
+        # _gs_lock guards the scores table: reader threads insert rows while
+        # the heartbeat thread iterates them in score()
+        with self._gs_lock:
+            return self._ps(peer).topic(topic, self.params.max_peer_topics)
 
     def score(self, peer: _Peer) -> float:
         """Combined peer score: per-topic terms + behaviour + frame-level."""
@@ -176,7 +179,9 @@ class GossipsubTransport(SocketTransport):
         st = self._ps(peer)
         total = peer.score  # wire-level events from the base transport
         now = time.monotonic()
-        for t, ts in st.scores.items():
+        with self._gs_lock:
+            score_rows = list(st.scores.items())
+        for t, ts in score_rows:
             tim = ts.time_in_mesh
             if ts.graft_time:
                 tim += now - ts.graft_time

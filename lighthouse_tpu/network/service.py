@@ -47,8 +47,22 @@ class BeaconNodeService:
         )
         self.op_pool = op_pool or OperationPool(spec, self.chain.ns.Attestation)
         self.router = Router(self)
-        self.sync = SyncManager(self)
+        # loopback runs sync inline (the deterministic simulator contract);
+        # socket stacks get the dedicated sync worker thread
+        from .transport import LoopbackTransport
+
+        self.sync = SyncManager(
+            self, threaded=not isinstance(transport, LoopbackTransport)
+        )
         transport.register(node_id, self)
+
+    def stop(self) -> None:
+        """Shut down the sync worker before the transport so no sync round
+        runs against closed sockets."""
+        self.sync.stop()
+        stop = getattr(self.transport, "stop", None)
+        if stop is not None:
+            stop()
 
     # -- transport-facing --------------------------------------------------
 
@@ -112,14 +126,15 @@ class BeaconNodeService:
             self.chain.process_block(block)
         except BlockError as e:
             if "unknown parent" in str(e):
-                # ask the sender where we are (single-block lookup -> range)
+                # single-block parent lookup (sync/block_lookups/), falling
+                # back to a status handshake -> range sync for deep gaps
+                self.sync.on_unknown_parent(block, from_peer)
                 try:
                     theirs = self.transport.request(
                         self.node_id, from_peer, "status", self.local_status()
                     )
                     self.sync.on_peer_status(from_peer, theirs)
-                    self.chain.process_block(block)
-                except (ConnectionError, BlockError):
+                except ConnectionError:
                     pass
             # other invalid blocks are dropped (peer scoring would fire here)
 
@@ -164,6 +179,12 @@ class BeaconNodeService:
             self.chain.process_chain_segment(list(blocks))
         except BlockError:
             pass  # scored + retried against another peer in the full stack
+
+    def process_chain_segment_strict(self, blocks) -> None:
+        """Segment import that RAISES on failure so the sync manager can
+        demote the serving peer and retry elsewhere (range_sync batch
+        failure handling)."""
+        self.chain.process_chain_segment(list(blocks))
 
     # -- rpc handlers ------------------------------------------------------
 

@@ -114,6 +114,14 @@ class SocketTransport(Transport):
             for p in self._peers.values():
                 p.score *= SCORE_DECAY
 
+    def report_peer(self, addr: str, delta: float) -> None:
+        """Application-level score report (sync demotions etc. — the
+        reference's PeerAction reporting into the peer manager)."""
+        with self._lock:
+            peer = self._peers.get(addr)
+        if peer is not None and peer.adjust_score(delta) <= SCORE_BAN_THRESHOLD:
+            self._drop_peer(peer, "banned (reported)")
+
     def _gossip_body(self, topic: str, message) -> tuple[bytes, bytes]:
         """Encode a gossip message into (msg_id, wire body). The single
         definition of message identity: sha256(topic || payload)[:20]."""

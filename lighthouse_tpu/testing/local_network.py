@@ -123,7 +123,7 @@ class LocalNetwork:
     def stop(self) -> None:
         if self.mode == "sockets":
             for n in self.nodes:
-                n.transport.stop()
+                n.stop()
             if self.boot is not None:
                 self.boot.stop()
 

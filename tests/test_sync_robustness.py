@@ -1,0 +1,250 @@
+"""Sync robustness: backfill, single-block lookups, peer failure handling.
+
+Refs: network/src/sync/backfill_sync/mod.rs (backwards history download),
+beacon_chain/src/historical_blocks.rs (hash-chain + batch signature
+verification of backfilled segments), sync/block_lookups/ (unknown-parent
+walks), range_sync/batch.rs (per-batch retry + peer demotion).
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import BeaconChain, BlockError
+from lighthouse_tpu.network import BeaconNodeService, LoopbackTransport
+from lighthouse_tpu.network.sync import PEER_FAILURE_LIMIT
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def built_chain():
+    """A 12-slot chain (one harness drives it) shared by the module."""
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    h = StateHarness(spec, 16)
+    genesis = h.state.copy()
+    blocks = []
+    for slot in range(1, 13):
+        b = h.produce_block(slot)
+        h.apply_block(b)
+        blocks.append(b)
+    return spec, genesis, blocks
+
+
+def _full_node(spec, genesis, blocks, transport, name):
+    clock = ManualSlotClock(12)
+    svc = BeaconNodeService(
+        name, spec, genesis.copy(), transport, slot_clock=clock
+    )
+    for b in blocks:
+        svc.chain.process_block(b)
+    return svc
+
+
+# -- backfill ----------------------------------------------------------------
+
+def test_checkpoint_node_backfills_history(built_chain):
+    """A node booted from a mid-chain checkpoint state downloads history
+    backwards to genesis and can then serve it (backfill done-condition)."""
+    spec, genesis, blocks = built_chain
+    transport = LoopbackTransport()
+    full = _full_node(spec, genesis, blocks, transport, "full")
+
+    # checkpoint boot: anchor state at slot 8 (after block 8)
+    anchor = full.chain.state_by_root(blocks[7].message.tree_root()).copy()
+    late = BeaconNodeService(
+        "late", spec, anchor, transport, slot_clock=ManualSlotClock(12)
+    )
+    assert late.chain.oldest_block_slot == 8
+    assert not late.chain.backfill_complete
+
+    late.connect("full")  # status -> range sync forward + backfill backward
+    assert late.chain.head.slot == 12  # forward sync caught up
+    assert late.chain.backfill_complete
+    assert late.chain.oldest_block_slot == 1
+    # backfilled history is servable (historical_blocks.rs goal)
+    served = late.blocks_by_range(1, 12)
+    assert [int(b.message.slot) for b in served] == list(range(1, 13))
+
+
+def test_backfill_rejects_tampered_history(built_chain):
+    """A backfill segment with a forged signature fails the batched
+    verification and does not move the anchor."""
+    spec, genesis, blocks = built_chain
+    transport = LoopbackTransport()
+    _full_node(spec, genesis, blocks, transport, "full2")
+    chain = BeaconChain(spec, genesis.copy(), slot_clock=ManualSlotClock(12))
+    for b in blocks:
+        chain.process_block(b)
+    anchor = chain.state_by_root(blocks[7].message.tree_root()).copy()
+    late_chain = BeaconChain(spec, anchor, slot_clock=ManualSlotClock(12))
+
+    segment = [b.copy() for b in blocks[4:7]]  # slots 5..7
+    segment[1].signature = b"\xc0" + b"\x00" * 95  # forged
+    with pytest.raises(BlockError, match="signatures"):
+        late_chain.import_historical_blocks(segment)
+    assert late_chain.oldest_block_slot == 8
+
+    # non-linking segment (wrong tail) also rejected
+    with pytest.raises(BlockError, match="link"):
+        late_chain.import_historical_blocks([b.copy() for b in blocks[0:3]])
+
+    # the honest segment imports (slots 5..7 link to the anchor's parent)
+    assert late_chain.import_historical_blocks(blocks[4:7]) == 3
+    assert late_chain.oldest_block_slot == 5
+
+
+# -- single-block lookups ----------------------------------------------------
+
+def test_unknown_parent_triggers_parent_lookup(built_chain):
+    """A gossip block with an unknown parent is recovered by walking the
+    parent chain via blocks_by_root, then imported oldest-first."""
+    spec, genesis, blocks = built_chain
+    transport = LoopbackTransport()
+    full = _full_node(spec, genesis, blocks, transport, "full3")
+    late = BeaconNodeService(
+        "late3", spec, genesis.copy(), transport,
+        slot_clock=ManualSlotClock(12),
+    )
+    # late node saw nothing; a block at slot 12 arrives by gossip
+    assert late.chain.head.slot == 0
+    late.process_gossip_block((blocks[-1], "full3"))
+    assert late.chain.head.slot == 12
+    assert late.chain.head.root == full.chain.head.root
+
+
+# -- failure handling --------------------------------------------------------
+
+class LyingService:
+    """A 'peer' that advertises a huge head but serves nothing."""
+
+    def __init__(self, status):
+        self._status = status
+
+    def on_rpc(self, method, payload, from_peer):
+        if method == "status":
+            return self._status
+        if method == "blocks_by_range":
+            return []
+        if method == "blocks_by_root":
+            return []
+        raise ValueError(method)
+
+    def on_gossip(self, *a):
+        pass
+
+
+def test_lying_peer_is_demoted_and_sync_completes(built_chain):
+    """A peer advertising a bogus high head gets demoted after its promised
+    blocks never arrive; sync then completes from an honest peer
+    (VERDICT r2 weakness #4 done-condition)."""
+    from lighthouse_tpu.network.transport import Status
+    from lighthouse_tpu.types.helpers import compute_fork_digest
+
+    spec, genesis, blocks = built_chain
+    transport = LoopbackTransport()
+    full = _full_node(spec, genesis, blocks, transport, "full4")
+
+    late = BeaconNodeService(
+        "late4", spec, genesis.copy(), transport,
+        slot_clock=ManualSlotClock(12),
+    )
+    st = late.chain.head.state
+    liar_status = Status(
+        fork_digest=compute_fork_digest(
+            bytes(st.fork.current_version), bytes(st.genesis_validators_root)
+        ),
+        finalized_root=b"\x00" * 32,
+        finalized_epoch=99,
+        head_root=b"\xfe" * 32,
+        head_slot=10_000,
+    )
+    transport.register("liar", LyingService(liar_status))
+
+    # the liar reports first and becomes the sync target
+    late.sync.on_peer_status("liar", liar_status)
+    assert late.sync.peer_failures.get("liar", 0) >= 1  # demoted
+    # honest peer finishes the job
+    late.connect("full4")
+    assert late.chain.head.slot == 12
+    assert late.chain.head.root == full.chain.head.root
+
+
+def test_bad_segment_rotates_to_honest_peer(built_chain):
+    """A peer serving corrupt segments is demoted; the batch retries against
+    the honest peer and sync completes (range_sync/batch.rs retries)."""
+    from lighthouse_tpu.network.transport import Status
+
+    spec, genesis, blocks = built_chain
+    transport = LoopbackTransport()
+    full = _full_node(spec, genesis, blocks, transport, "full5")
+
+    class CorruptingService(LyingService):
+        def on_rpc(self, method, payload, from_peer):
+            if method == "blocks_by_range":
+                start, count = payload
+                out = [
+                    b.copy() for b in blocks
+                    if start <= int(b.message.slot) < start + count
+                ]
+                for b in out:
+                    b.signature = b"\xc0" + b"\x00" * 95  # corrupt
+                return out
+            return super().on_rpc(method, payload, from_peer)
+
+    late = BeaconNodeService(
+        "late5", spec, genesis.copy(), transport,
+        slot_clock=ManualSlotClock(12),
+    )
+    corrupt_status = full.local_status()
+    transport.register("corrupt", CorruptingService(corrupt_status))
+    late.sync.on_peer_status("corrupt", corrupt_status)
+    # corrupt segments demote the peer; sync stalls but does not wedge
+    assert late.sync.peer_failures.get("corrupt", 0) >= 1
+    late.connect("full5")
+    assert late.chain.head.slot == 12
+    assert late.chain.head.root == full.chain.head.root
+    # demotions were bounded (no infinite retry against the corrupt peer)
+    assert late.sync.peer_failures["corrupt"] <= PEER_FAILURE_LIMIT
+
+
+def test_threaded_sync_does_not_block_caller(built_chain):
+    """Socket-mode sync runs on the worker: on_peer_status returns fast even
+    when the download takes a while (manager.rs own-task semantics)."""
+    from lighthouse_tpu.network.transport import Status
+
+    spec, genesis, blocks = built_chain
+
+    class SlowTransport(LoopbackTransport):
+        def request(self, from_peer, to_peer, method, payload):
+            time.sleep(0.3)
+            return super().request(from_peer, to_peer, method, payload)
+
+    transport = SlowTransport()
+    full = _full_node(spec, genesis, blocks, transport, "full6")
+    late = BeaconNodeService(
+        "late6", spec, genesis.copy(), transport,
+        slot_clock=ManualSlotClock(12),
+    )
+    late.sync._threaded = True  # loopback defaults to inline; force worker
+    import threading
+
+    late.sync._thread = threading.Thread(
+        target=late.sync._worker, daemon=True
+    )
+    late.sync._thread.start()
+    t0 = time.monotonic()
+    late.sync.on_peer_status("full6", full.local_status())
+    assert time.monotonic() - t0 < 0.2  # caller not blocked on the download
+    assert late.sync.wait_idle(30)
+    assert late.chain.head.slot == 12
