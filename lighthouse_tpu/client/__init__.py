@@ -135,7 +135,22 @@ class Client:
         if self.metrics_server is not None:
             self.metrics_server.stop()
         if self.network_service is not None:
-            self.network_service.transport.stop()
+            self.network_service.stop()
+        # persist fork choice + op pool for the next boot
+        # (persisted_fork_choice.rs / operation_pool persistence.rs)
+        try:
+            from ..fork_choice import persistence as fc_persist
+            from ..op_pool import persistence as pool_persist
+
+            self.chain.store.put_meta(
+                fc_persist.META_KEY,
+                fc_persist.serialize_fork_choice(self.chain.fork_choice),
+            )
+            self.chain.store.put_meta(
+                pool_persist.META_KEY, pool_persist.serialize_pool(self.op_pool)
+            )
+        except Exception as e:  # noqa: BLE001 — shutdown must not fail
+            log.warn("Persistence on shutdown failed", error=str(e))
 
     def wait_for_shutdown(self) -> None:
         """Block until stop() or KeyboardInterrupt (Environment's shutdown
@@ -233,6 +248,47 @@ class ClientBuilder:
         if self._eth1 is not None:
             chain.eth1_service = self._eth1
         op_pool = OperationPool(self.spec, chain.ns.Attestation)
+
+        # restore persisted fork choice + op pool (persisted_fork_choice.rs,
+        # operation_pool/persistence.rs): best-effort — a corrupt or
+        # incompatible snapshot falls back to the fresh anchor
+        from ..fork_choice import persistence as fc_persist
+        from ..op_pool import persistence as pool_persist
+
+        blob = store.get_meta(fc_persist.META_KEY)
+        if blob:
+            fresh_fc = chain.fork_choice
+            try:
+                restored = fc_persist.restore_fork_choice(self.spec, blob)
+                if chain.genesis_block_root in restored.proto.indices:
+                    # rehydrate the unfinalized blocks the restored graph
+                    # references — imports, production and serving all key
+                    # off the chain's block/seen maps
+                    for node in restored.proto.nodes:
+                        raw = store.get_block(node.root)
+                        if raw is not None:
+                            fork = self.spec.fork_name_at_slot(node.slot)
+                            chain._blocks[node.root] = chain.ns.block_types[
+                                fork
+                            ].decode(raw)
+                        chain._seen_blocks.add(node.root)
+                    chain.fork_choice = restored
+                    chain.recompute_head()
+                    log.info(
+                        "Fork choice restored",
+                        nodes=len(restored.proto.nodes),
+                        head=chain.head.root.hex()[:10],
+                    )
+            except Exception as e:  # noqa: BLE001 — stale snapshot
+                chain.fork_choice = fresh_fc
+                log.warn("Fork choice restore failed", error=str(e))
+        blob = store.get_meta(pool_persist.META_KEY)
+        if blob:
+            try:
+                n = pool_persist.restore_pool(op_pool, chain.ns, blob)
+                log.info("Op pool restored", attestations=n)
+            except Exception as e:  # noqa: BLE001
+                log.warn("Op pool restore failed", error=str(e))
 
         network_service = None
         if cfg.listen_port is not None:

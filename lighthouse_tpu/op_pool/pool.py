@@ -34,6 +34,9 @@ class OperationPool:
         # here one pool lock serializes inserts (HTTP publishers) against
         # packing reads (block production).
         self._lock = threading.RLock()
+        from .reward_cache import RewardCache
+
+        self.reward_cache = RewardCache()
 
     # -- attestations (insert_attestation, lib.rs:200) ---------------------------
 
@@ -70,6 +73,7 @@ class OperationPool:
         cur, prev = get_current_epoch(spec, state), get_previous_epoch(spec, state)
         candidates = []
         n_val = len(state.validators)
+        self.reward_cache.update(spec, state)
         with self._lock:
             entries = [
                 (data, [(b.copy(), s) for b, s in variants])
@@ -101,7 +105,9 @@ class OperationPool:
                     continue
                 mask = np.zeros(n_val, dtype=bool)
                 mask[committee[bits].astype(np.int64)] = True
-                weights = np.ones(n_val, dtype=np.uint64)  # reward cache later
+                weights = self.reward_cache.weights_for_epoch(
+                    int(data.target.epoch), n_val
+                )
                 att = self.att_cls(
                     aggregation_bits=bits.copy(), data=data,
                     signature=oc.g2_compress(sig),

@@ -145,6 +145,16 @@ class ProductionValidatorClient:
                     self.doppelganger.check(
                         epoch, self.duties.validator_indices()
                     )
+            # prune slashing-protection history below finality once per
+            # epoch (slashing_database.rs prune; the max entry per
+            # validator always survives as the signing lower bound)
+            try:
+                fin = self.client.get_finality_checkpoints()
+                fin_epoch = int(fin["finalized"]["epoch"])
+                if fin_epoch > 0:
+                    self.store.slashing_db.prune(fin_epoch, spe)
+            except Exception:  # noqa: BLE001 — pruning is best-effort
+                pass
             self._last_duties_epoch = epoch
         proposed = self.blocks.propose(slot)
         attested = self.attestations.attest(slot)

@@ -158,6 +158,35 @@ class SlashingDatabase:
 
     # -- interchange (EIP-3076) ----------------------------------------------------
 
+    def prune(self, finalized_epoch: int, slots_per_epoch: int = 32) -> dict:
+        """Drop history that can no longer protect anything
+        (``slashing_database.rs`` prune_all_signed_{blocks,attestations}):
+        finalized data is immutable, so entries strictly below the
+        finalized boundary are dead weight — EXCEPT each validator's
+        maximum entry, which is the lower bound future signings are
+        checked against and must survive."""
+        finalized_slot = finalized_epoch * slots_per_epoch
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(
+                """DELETE FROM signed_blocks WHERE slot < ? AND slot < (
+                     SELECT MAX(slot) FROM signed_blocks b2
+                     WHERE b2.validator_id = signed_blocks.validator_id)""",
+                (finalized_slot,),
+            )
+            blocks = cur.rowcount
+            cur.execute(
+                """DELETE FROM signed_attestations
+                   WHERE target_epoch < ? AND target_epoch < (
+                     SELECT MAX(target_epoch) FROM signed_attestations a2
+                     WHERE a2.validator_id
+                           = signed_attestations.validator_id)""",
+                (finalized_epoch,),
+            )
+            atts = cur.rowcount
+            self._conn.commit()
+        return {"blocks_pruned": blocks, "attestations_pruned": atts}
+
     def export_interchange(self, genesis_validators_root: bytes) -> dict:
         with self._lock:
             data = []

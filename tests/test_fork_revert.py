@@ -1,0 +1,102 @@
+"""Fork revert: recovery from an unusable head (fork_revert.rs) +
+slashing-protection pruning (slashing_database.rs).
+"""
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import BeaconChain
+from lighthouse_tpu.beacon_chain.fork_revert import revert_to_fork_boundary
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+def test_revert_drops_bad_subtree_and_keeps_chain_usable():
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock)
+    roots = {}
+    for slot in range(1, 6):
+        clock.set_slot(slot)
+        b = h.produce_block(slot)
+        h.apply_block(b)
+        roots[slot] = chain.process_block(b)
+    assert chain.head.slot == 5
+
+    # slot-4 block turns out corrupt: revert. Head falls to slot 3, the
+    # slot-4/5 subtree is erased everywhere.
+    new_head = revert_to_fork_boundary(chain, roots[4])
+    assert new_head == roots[3]
+    assert chain.head.slot == 3
+    for s in (4, 5):
+        assert roots[s] not in chain._blocks
+        assert roots[s] not in chain.fork_choice.proto.indices
+    for s in (1, 2, 3):
+        assert roots[s] in chain.fork_choice.proto.indices
+
+    # the chain keeps working: a replacement block at slot 4 imports and
+    # becomes head (the healthy-branch continuation)
+    h2 = StateHarness(spec, 16)
+    for slot in range(1, 4):
+        h2.apply_block(h2.produce_block(slot))
+    clock.set_slot(4)
+    b4 = h2.produce_block(4)
+    h2.apply_block(b4)
+    r4 = chain.process_block(b4)
+    assert chain.head.root == r4
+    assert chain.head.slot == 4
+
+
+def test_revert_whole_chain_falls_back_to_anchor():
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    h = StateHarness(spec, 16)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock)
+    first = None
+    for slot in (1, 2):
+        clock.set_slot(slot)
+        b = h.produce_block(slot)
+        h.apply_block(b)
+        r = chain.process_block(b)
+        first = first or r
+    new_head = revert_to_fork_boundary(chain, first)
+    assert new_head == chain.genesis_block_root
+    assert len(chain.fork_choice.proto.nodes) == 1
+
+
+def test_slashing_protection_prune_keeps_max_entries():
+    from lighthouse_tpu.validator_client.slashing_protection import (
+        NotSafe,
+        SlashingDatabase,
+    )
+
+    db = SlashingDatabase()
+    pk = b"\xaa" * 48
+    db.register_validator(pk)
+    for slot in (10, 20, 30):
+        db.check_and_insert_block_proposal(pk, slot, b"\x01" * 32)
+    for src, tgt in ((0, 1), (1, 2), (2, 3)):
+        db.check_and_insert_attestation(pk, src, tgt, b"\x02" * 32)
+
+    out = db.prune(finalized_epoch=2, slots_per_epoch=8)  # boundary slot 16
+    assert out["blocks_pruned"] == 1     # slot 10 < 16; 20,30 stay
+    assert out["attestations_pruned"] == 1  # target 1 < 2; 2,3 stay
+
+    # the per-validator maximum entries survive and still protect:
+    with pytest.raises(NotSafe):
+        db.check_and_insert_block_proposal(pk, 25, b"\x03" * 32)  # below max
+    with pytest.raises(NotSafe):
+        db.check_and_insert_attestation(pk, 0, 2, b"\x04" * 32)
+    # and signing ahead still works
+    db.check_and_insert_block_proposal(pk, 40, b"\x05" * 32)
+    db.check_and_insert_attestation(pk, 3, 4, b"\x06" * 32)

@@ -1,0 +1,188 @@
+"""Op-pool reward cache + pool/fork-choice persistence.
+
+Refs: operation_pool/src/reward_cache.rs (packing weights from participation
+flags), operation_pool/src/persistence.rs (pool survives restarts),
+beacon_chain/src/persisted_fork_choice.rs (fork choice survives restarts).
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import BeaconChain
+from lighthouse_tpu.op_pool import OperationPool
+from lighthouse_tpu.op_pool.persistence import restore_pool, serialize_pool
+from lighthouse_tpu.op_pool.reward_cache import (
+    TIMELY_TARGET_FLAG_INDEX,
+    RewardCache,
+)
+from lighthouse_tpu.fork_choice.persistence import (
+    restore_fork_choice,
+    serialize_fork_choice,
+)
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.containers import for_preset
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+# -- reward cache ------------------------------------------------------------
+
+def test_reward_cache_zeroes_already_attested():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = StateHarness(spec, 16)
+    state = h.state
+    state.current_epoch_participation[3] |= 1 << TIMELY_TARGET_FLAG_INDEX
+    state.current_epoch_participation[5] |= 1 << TIMELY_TARGET_FLAG_INDEX
+    cache = RewardCache()
+    cache.update(spec, state)
+    epoch = spec.compute_epoch_at_slot(int(state.slot))
+    w = cache.weights_for_epoch(epoch, 16)
+    assert w[3] == 0 and w[5] == 0
+    # everyone else weighs their effective balance in increments
+    assert w[0] == int(state.validators[0].effective_balance) // int(
+        spec.effective_balance_increment
+    )
+    others = np.ones(16, dtype=bool)
+    others[[3, 5]] = False
+    assert (w[others] > 0).all()
+    # unknown epoch: neutral all-ones fallback
+    assert (cache.weights_for_epoch(99, 16) == 1).all()
+
+
+def test_reward_cache_invalidates_on_state_change():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = StateHarness(spec, 16)
+    cache = RewardCache()
+    cache.update(spec, h.state)
+    epoch = spec.compute_epoch_at_slot(int(h.state.slot))
+    before = cache.weights_for_epoch(epoch, 16).copy()
+    h.state.current_epoch_participation[0] |= 1 << TIMELY_TARGET_FLAG_INDEX
+    cache.update(spec, h.state)  # same key -> cached (no recompute)
+    assert cache.weights_for_epoch(epoch, 16)[0] == before[0]
+    b = h.produce_block(int(h.state.slot) + 1)
+    h.apply_block(b)
+    cache.update(spec, h.state)  # state advanced -> recompute
+    assert cache.weights_for_epoch(epoch, 16)[0] == 0
+
+
+def test_max_cover_prefers_unattested_validators():
+    """Two disjoint attestations, one covering already-attested validators:
+    the reward-weighted packer picks the productive one first."""
+    from lighthouse_tpu.op_pool.max_cover import maximum_cover
+
+    w = np.asarray([32, 32, 0, 0], dtype=np.uint64)  # 2,3 already attested
+    stale = (np.asarray([False, False, True, True]), w, "stale")
+    fresh = (np.asarray([True, True, False, False]), w, "fresh")
+    assert maximum_cover([stale, fresh], 1) == ["fresh"]
+
+
+# -- op pool persistence -----------------------------------------------------
+
+def test_pool_persistence_roundtrip():
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    h = StateHarness(spec, 16)
+    ns = for_preset("minimal")
+    pool = OperationPool(spec, ns.Attestation)
+    b1 = h.produce_block(1)
+    h.apply_block(b1)
+    for att in h.attestations_for_slot(h.state, 1, b1.message.tree_root()):
+        pool.insert_attestation(att)
+    n_before = pool.num_attestations()
+    assert n_before > 0
+    packed_before = [
+        type(a).encode(a) for a in pool.get_attestations(h.state)
+    ]
+
+    blob = serialize_pool(pool)
+    pool2 = OperationPool(spec, ns.Attestation)
+    assert restore_pool(pool2, ns, blob) == n_before
+    assert pool2.num_attestations() == n_before
+    packed_after = [
+        type(a).encode(a) for a in pool2.get_attestations(h.state)
+    ]
+    assert packed_before == packed_after
+
+
+# -- fork choice persistence -------------------------------------------------
+
+def test_fork_choice_persistence_roundtrip():
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+    h = StateHarness(spec, 16)
+    genesis = h.state.copy()
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, genesis.copy(), slot_clock=clock)
+    for slot in range(1, 7):
+        clock.set_slot(slot)
+        b = h.produce_block(slot)
+        h.apply_block(b)
+        chain.process_block(b)
+        for att in h.unaggregated_attestations_for_slot(
+            h.state, slot, b.message.tree_root()
+        ):
+            chain.verify_unaggregated_attestations([att])
+
+    fc = chain.fork_choice
+    blob = serialize_fork_choice(fc)
+    restored = restore_fork_choice(spec, blob)
+    assert len(restored.proto.nodes) == len(fc.proto.nodes)
+    assert restored.get_head(7) == fc.get_head(7)
+    assert restored.store.justified_checkpoint == fc.store.justified_checkpoint
+    np.testing.assert_array_equal(
+        restored.proto._vote_next, fc.proto._vote_next
+    )
+    # the restored instance keeps working: advance time + recompute head
+    restored.update_time(8)
+    assert restored.get_head(8) == fc.get_head(8)
+
+
+def test_client_restart_restores_fork_choice_and_pool(tmp_path):
+    """ClientBuilder + datadir: stop persists, rebuild restores — the node
+    keeps its head and pool across restarts (extends the r2 datadir test)."""
+    from lighthouse_tpu.client import ClientBuilder, ClientConfig
+
+    spec = minimal_spec(altair_fork_epoch=2**64 - 1)
+
+    def make():
+        cfg = ClientConfig(
+            interop_validators=16, genesis_time=0, use_system_clock=False,
+            datadir=str(tmp_path), listen_port=None, http_enabled=False,
+        )
+        return ClientBuilder(spec, cfg).interop_genesis().slot_clock(
+            ManualSlotClock(0)
+        ).build()
+
+    client = make()
+    h = StateHarness(spec, 16)
+    clock = client.chain.slot_clock
+    for slot in (1, 2, 3):
+        clock.set_slot(slot)
+        b = h.produce_block(slot)
+        h.apply_block(b)
+        client.chain.process_block(b)
+    for att in h.attestations_for_slot(h.state, 3, client.chain.head.root):
+        client.op_pool.insert_attestation(att)
+    head_before = client.chain.head.root
+    pool_before = client.op_pool.num_attestations()
+    nodes_before = len(client.chain.fork_choice.proto.nodes)
+    client.stop()
+
+    client2 = make()
+    try:
+        assert len(client2.chain.fork_choice.proto.nodes) == nodes_before
+        # the wall clock resumes where it was in a real restart; the manual
+        # test clock restarts at 0, under which future blocks are unviable
+        client2.chain.slot_clock.set_slot(3)
+        client2.chain.recompute_head()
+        assert client2.chain.head.root == head_before
+        assert client2.op_pool.num_attestations() == pool_before
+    finally:
+        client2.stop()
