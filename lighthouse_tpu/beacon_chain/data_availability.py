@@ -31,6 +31,24 @@ def _commitments_field_index(body_cls) -> int:
     return [n for n, _ in body_cls.FIELDS].index("blob_kzg_commitments")
 
 
+def body_field_branch(body, field_index: int) -> list[bytes]:
+    """Sibling branch for one top-level field under the body root."""
+    import numpy as np
+
+    from ..ssz.merkle import next_pow2
+
+    body_cls = type(body)
+    field_roots = np.stack(
+        [
+            np.frombuffer(t.hash_tree_root(getattr(body, n)), dtype=np.uint8)
+            for n, t in body_cls.FIELDS
+        ]
+    )
+    return merkle_branch_from_chunks(
+        field_roots, next_pow2(len(body_cls.FIELDS)), field_index
+    )
+
+
 def commitment_inclusion_proof(ns, body, index: int) -> list[bytes]:
     """Branch proving body.blob_kzg_commitments[index] under the body root."""
     import numpy as np
@@ -52,17 +70,7 @@ def commitment_inclusion_proof(ns, body, index: int) -> list[bytes]:
     length_chunk = len(body.blob_kzg_commitments).to_bytes(8, "little") + b"\x00" * 24
     branch.append(length_chunk)
     # body-fields level
-    field_roots = np.stack(
-        [
-            np.frombuffer(t.hash_tree_root(getattr(body, n)), dtype=np.uint8)
-            for n, t in body_cls.FIELDS
-        ]
-    )
-    fi = _commitments_field_index(body_cls)
-    n_fields = len(body_cls.FIELDS)
-    from ..ssz.merkle import next_pow2
-
-    branch.extend(merkle_branch_from_chunks(field_roots, next_pow2(n_fields), fi))
+    branch.extend(body_field_branch(body, _commitments_field_index(body_cls)))
     return branch
 
 

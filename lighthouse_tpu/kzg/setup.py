@@ -34,7 +34,7 @@ class TrustedSetup:
         return len(self.g1_lagrange_brp)
 
 
-def insecure_setup(n: int, tau: int = 0x1234ABCD) -> TrustedSetup:
+def insecure_setup(n: int, tau: int = 0x1234ABCD, n_g2: int = 2) -> TrustedSetup:
     """TEST ONLY: a setup with known tau at domain size ``n``.
 
     Lets the full commit/prove/verify cycle run at small blob sizes (the
@@ -56,7 +56,9 @@ def insecure_setup(n: int, tau: int = 0x1234ABCD) -> TrustedSetup:
         for w in roots_brp
     ]
     monomial = [oc.g1_mul(g1, pow(tau, i, R)) for i in range(n)]
-    g2s = [g2, oc.g2_mul(g2, tau)]
+    # cell proofs pair against [tau^k]_2, so setups can carry more G2 powers
+    # (the ceremony output ships 65 for exactly this reason)
+    g2s = [oc.g2_mul(g2, pow(tau, i, R)) for i in range(max(2, n_g2))]
     return TrustedSetup(lagrange_brp, monomial, g2s)
 
 

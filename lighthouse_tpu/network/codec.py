@@ -70,6 +70,8 @@ class MessageCodec:
             raw = ns.SyncCommitteeMessage.encode(message)
         elif topic == Topic.SYNC_CONTRIBUTION:
             raw = ns.SignedContributionAndProof.encode(message)
+        elif topic == Topic.DATA_COLUMN_SIDECAR:
+            raw = ns.DataColumnSidecar.encode(message)
         else:
             raise WireError(f"no codec for topic {topic}")
         return zlib.compress(raw)
@@ -96,6 +98,8 @@ class MessageCodec:
             return ns.SyncCommitteeMessage.decode(raw)
         if topic == Topic.SYNC_CONTRIBUTION:
             return ns.SignedContributionAndProof.decode(raw)
+        if topic == Topic.DATA_COLUMN_SIDECAR:
+            return ns.DataColumnSidecar.decode(raw)
         raise WireError(f"no codec for topic {topic}")
 
     # -- rpc ---------------------------------------------------------------

@@ -65,6 +65,14 @@ class Router:
                     process_individual=svc.process_gossip_sync_contribution,
                 )
             )
+        elif topic == Topic.DATA_COLUMN_SIDECAR:
+            svc.processor.submit(
+                Work(
+                    work_type=WorkType.GossipBlock,  # block-class priority
+                    item=message,
+                    process_individual=svc.process_gossip_data_column,
+                )
+            )
         elif topic == Topic.VOLUNTARY_EXIT:
             svc.processor.submit(
                 Work(

@@ -165,6 +165,41 @@ class BeaconNodeService:
     def process_gossip_sync_contribution(self, sc) -> None:
         self.chain.verify_sync_contributions([sc])
 
+    def process_gossip_data_column(self, sidecar) -> None:
+        """PeerDAS column ingest groundwork: verify + retain by block root
+        (data_column_verification.rs gossip path)."""
+        chain = self.chain
+        ctx = getattr(chain, "cell_context", None)
+        if ctx is None:
+            return  # column sampling not enabled on this node
+        from ..beacon_chain.data_columns import (
+            DataColumnError,
+            verify_data_column_sidecar,
+        )
+
+        try:
+            verify_data_column_sidecar(chain.ns, sidecar, ctx)
+        except DataColumnError:
+            return  # invalid columns drop (peer scoring fires upstream)
+        cache = getattr(chain, "data_column_cache", None)
+        if cache is None:
+            cache = chain.data_column_cache = {}
+        root = sidecar.signed_block_header.message.tree_root()
+        cache.setdefault(root, {})[int(sidecar.index)] = sidecar
+        # bounded: drop column sets for slots at or below finality
+        fin_slot = chain.spec.start_slot(
+            int(chain.fork_choice.store.finalized_checkpoint[0])
+        )
+        if len(cache) > 64:
+            for r in [
+                r for r, cols in cache.items()
+                if any(
+                    int(s.signed_block_header.message.slot) <= fin_slot
+                    for s in cols.values()
+                )
+            ]:
+                del cache[r]
+
     def process_gossip_exit(self, exit_msg) -> None:
         self.op_pool.insert_voluntary_exit(exit_msg)
 

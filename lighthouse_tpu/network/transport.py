@@ -22,6 +22,7 @@ class Topic:
     ATTESTER_SLASHING = "attester_slashing"
     SYNC_COMMITTEE_MESSAGE = "sync_committee"  # subnet topics collapse to one
     SYNC_CONTRIBUTION = "sync_committee_contribution_and_proof"
+    DATA_COLUMN_SIDECAR = "data_column_sidecar"  # PeerDAS (subnets collapse)
 
 
 @dataclass

@@ -545,6 +545,39 @@ def for_preset(preset_name: str) -> SimpleNamespace:
     class BlobIdentifier(Container):
         FIELDS = [("block_root", Root), ("index", uint64)]
 
+    # -- PeerDAS / fulu groundwork (EIP-7594) --------------------------------
+    # consensus/types/src/data_column_sidecar.rs: columns slice the erasure-
+    # extended blob matrix the other way — one cell per blob per column.
+
+    NUMBER_OF_COLUMNS = 128          # spec CELLS_PER_EXT_BLOB geometry
+    BYTES_PER_CELL = 2048            # 64 field elements x 32 bytes
+    Cell = ByteVector(BYTES_PER_CELL)
+    # the proof covers the WHOLE blob_kzg_commitments list root under the
+    # body root (one body-depth branch), unlike the per-commitment blob path
+    KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH = _body_depth
+
+    class DataColumnSidecar(Container):
+        FIELDS = [
+            ("index", uint64),
+            ("column", List(Cell, p.MAX_BLOB_COMMITMENTS_PER_BLOCK)),
+            (
+                "kzg_commitments",
+                List(KZGCommitment, p.MAX_BLOB_COMMITMENTS_PER_BLOCK),
+            ),
+            (
+                "kzg_proofs",
+                List(ByteVector(48), p.MAX_BLOB_COMMITMENTS_PER_BLOCK),
+            ),
+            ("signed_block_header", SignedBeaconBlockHeader),
+            (
+                "kzg_commitments_inclusion_proof",
+                Vector(Root, KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH),
+            ),
+        ]
+
+    class DataColumnIdentifier(Container):
+        FIELDS = [("block_root", Root), ("index", uint64)]
+
     # -- electra variants (EIP-6110/7002/7251/7549) --------------------------
 
     class DepositRequest(Container):
@@ -731,6 +764,12 @@ def for_preset(preset_name: str) -> SimpleNamespace:
         BlobSidecar=BlobSidecar,
         BlobIdentifier=BlobIdentifier,
         KZG_COMMITMENT_INCLUSION_PROOF_DEPTH=KZG_COMMITMENT_INCLUSION_PROOF_DEPTH,
+        NUMBER_OF_COLUMNS=NUMBER_OF_COLUMNS,
+        BYTES_PER_CELL=BYTES_PER_CELL,
+        Cell=Cell,
+        DataColumnSidecar=DataColumnSidecar,
+        DataColumnIdentifier=DataColumnIdentifier,
+        KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH=KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH,
         DepositRequest=DepositRequest,
         WithdrawalRequest=WithdrawalRequest,
         ConsolidationRequest=ConsolidationRequest,
