@@ -368,26 +368,24 @@ def _bench_native(pks_raw, idx, msgs, sigs) -> float:
     return N_SETS / dt
 
 
-def main():
-    global N_SETS, KEYS_PER_SET, N_VALIDATORS, BATCH, _FIXTURE
-    platform, notes = _probe_accelerator()
-    for note in notes:
-        print(f"# {note}", file=sys.stderr)
-    fallback = platform is None
+def _enable_compile_cache():
+    """The persistent XLA compilation cache is enabled by lighthouse_tpu's
+    package init (host-partitioned .jax_cache); importing the package is
+    enough. Kept as a seam for cache-dir overrides in CI."""
+    import lighthouse_tpu  # noqa: F401
+
+
+def _inner():
+    """Run the full native + device measurement at the env-given shapes and
+    print the JSON record. Invoked in a SUBPROCESS by main() so a wedged or
+    pathologically slow device compile is bounded by the parent's timeout
+    instead of producing no record at all."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
     if fallback:
-        # device init is wedged (e.g. a stuck tunnel): pin CPU BEFORE any jax
-        # import in this process. The mainnet shape is hours of CPU work, so
-        # unless shapes were pinned explicitly, shrink them — an honest small
-        # number beats a timeout recording nothing. The JSON says fallback.
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        if "BENCH_SETS" not in os.environ:
-            N_SETS, KEYS_PER_SET, N_VALIDATORS, BATCH = 16, 64, 2048, 8
-            _FIXTURE = os.path.join(
-                _CACHE_DIR,
-                f"fixture_v{N_VALIDATORS}_s{N_SETS}_k{KEYS_PER_SET}.npz",
-            )
     pks_comp, pks_raw, idx, msgs, sigs = _fixture()
     native = _bench_native(pks_raw, idx, msgs, sigs)
     print(f"# native (C++ single-core): {native:.2f} sets/s", flush=True)
@@ -421,6 +419,94 @@ def main():
                 "stages_ms_per_batch": stages,
                 "kernel_gflops_per_batch": round(flops / 1e9, 2) if flops else None,
                 "mfu_estimate": mfu,
+            }
+        )
+    )
+
+
+# Shape ladder: (sets, keys, validators, batch, timeout_s). The first entry
+# is the mainnet shape (BASELINE.json config #4); smaller rungs bound a
+# pathological device compile (observed: the tunnel's server-side compile of
+# the 64x512 fused kernel exceeding 50 minutes) so SOME honest record always
+# lands. The JSON's `shape` field says which rung ran.
+_LADDER = [
+    (256, 448, 16384, 64, 2700.0),
+    (64, 64, 4096, 16, 1200.0),
+    (16, 16, 1024, 8, 900.0),
+]
+
+
+def main():
+    if "--inner" in sys.argv:
+        _inner()
+        return
+    platform, notes = _probe_accelerator()
+    for note in notes:
+        print(f"# {note}", file=sys.stderr)
+    fallback = platform is None
+
+    if "BENCH_SETS" in os.environ:
+        ladder = [
+            (N_SETS, KEYS_PER_SET, N_VALIDATORS, BATCH,
+             float(os.environ.get("BENCH_TIMEOUT", "2700"))),
+        ]
+    elif fallback:
+        # wedged tunnel: CPU at the small rung only (mainnet shape is hours
+        # of CPU work; an honest small record beats a timeout)
+        ladder = [(16, 64, 2048, 8, 1800.0)]
+    else:
+        ladder = _LADDER
+
+    last_err = ""
+    for sets, keys, validators, batch, timeout in ladder:
+        env = dict(
+            os.environ,
+            BENCH_SETS=str(sets),
+            BENCH_KEYS=str(keys),
+            BENCH_VALIDATORS=str(validators),
+            BENCH_BATCH=str(batch),
+        )
+        if fallback:
+            env["BENCH_FALLBACK"] = "1"
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"],
+                env=env,
+                capture_output=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"shape ({sets}x{keys}) exceeded {timeout:.0f}s"
+            print(f"# {last_err}; trying next rung", file=sys.stderr)
+            continue
+        sys.stderr.write(out.stderr.decode(errors="replace")[-2000:])
+        stdout = out.stdout.decode(errors="replace")
+        json_lines = [
+            ln for ln in stdout.splitlines() if ln.startswith("{")
+        ]
+        for ln in stdout.splitlines():
+            if ln.startswith("#"):
+                print(ln, file=sys.stderr)
+        if out.returncode == 0 and json_lines:
+            print(json_lines[-1])
+            return
+        last_err = (
+            f"shape ({sets}x{keys}) rc={out.returncode}: "
+            + out.stderr.decode(errors="replace")[-300:].strip()
+        )
+        print(f"# {last_err}", file=sys.stderr)
+    # every rung failed: emit an honest failure record rather than nothing
+    print(
+        json.dumps(
+            {
+                "metric": "bls_attestation_sets_verified_per_s",
+                "value": 0.0,
+                "unit": "sets/s",
+                "vs_baseline": 0.0,
+                "platform": platform,
+                "fallback": fallback,
+                "error": last_err or "no shape rung completed",
             }
         )
     )

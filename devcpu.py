@@ -49,3 +49,8 @@ _set_env()
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# NOTE: the persistent XLA compilation cache is enabled by
+# lighthouse_tpu/__init__.py (host-fingerprint-partitioned .jax_cache) —
+# nothing to do here; keep this module import-light.

@@ -170,7 +170,10 @@ class BeaconNodeHttpClient:
 
     def get_head_header(self):
         d = self._get("/eth/v1/beacon/headers/head")["data"]
-        return {"root": _unhex(d["root"]), "slot": int(d["header"]["slot"])}
+        return {
+            "root": _unhex(d["root"]),
+            "slot": int(d["header"]["message"]["slot"]),
+        }
 
     def get_validator_liveness(self, epoch: int, indices: list[int]):
         return self._post(f"/eth/v1/validator/liveness/{epoch}", indices)["data"]

@@ -409,12 +409,19 @@ class BeaconChain:
         res = self.da_checker.put_blob(sidecar)
         if res is None:
             return None
-        blk, _blobs = res
+        blk, blobs = res
         root = BeaconBlockHeader.hash_tree_root(hdr.message)
         with self.lock:
-            return self._process_block_locked(
+            imported = self._process_block_locked(
                 blk, blk.message, root, True, check_availability=False
             )
+        # persist the sidecars beside the block (the reference's blobs DB) —
+        # serves /eth/v1/beacon/blob_sidecars and BlobsByRoot RPC
+        if imported is not None and blobs:
+            self.store.put_blob_sidecars(
+                root, [type(sc).encode(sc) for sc in blobs]
+            )
+        return imported
 
     def _notify_execution_layer(self, signed_block):
         """engine_newPayload for merge-era blocks; maps the EL verdict onto
@@ -603,6 +610,11 @@ class BeaconChain:
             # range-synced blocks carry slashing evidence too (the slasher
             # subscription must see every import path, not just gossip)
             self._notify_block_observers(sb)
+            sidecars = blobs_by_root.get(root)
+            if sidecars:
+                self.store.put_blob_sidecars(
+                    root, [type(sc).encode(sc) for sc in sidecars]
+                )
             roots.append(root)
         return roots
 
@@ -1059,8 +1071,63 @@ class BeaconChain:
 
     # -- production -------------------------------------------------------------------
 
+    def _produce_payload(self, state, slot: int, fork: str):
+        """engine_forkchoiceUpdated(attributes) -> engine_getPayload — the
+        production half of the engine API (execution_layer get_payload flow).
+        Returns None pre-merge (default payload stands in)."""
+        from ..execution_layer.engine import PayloadAttributes
+        from ..state_transition.per_block import (
+            compute_timestamp_at_slot,
+            is_merge_transition_complete,
+            _expected_withdrawals_list,
+        )
+        from ..state_transition import get_randao_mix
+        from ..types.spec import fork_at_least
+
+        if not is_merge_transition_complete(state):
+            return None  # pre-merge: the default payload is the right body
+        head_hash = bytes(state.latest_execution_payload_header.block_hash)
+        withdrawals = (
+            _expected_withdrawals_list(self.spec, state)
+            if fork_at_least(fork, "capella")
+            else None
+        )
+        attrs = PayloadAttributes(
+            timestamp=compute_timestamp_at_slot(self.spec, state, slot),
+            prev_randao=get_randao_mix(
+                self.spec, state, get_current_epoch(self.spec, state)
+            ),
+            suggested_fee_recipient=b"\x00" * 20,
+            withdrawals=withdrawals,
+            # deneb+: V3 attributes carry the parent beacon block root
+            parent_beacon_block_root=(
+                bytes(state.latest_block_header.tree_root())
+                if fork_at_least(fork, "deneb")
+                else None
+            ),
+        )
+        # the engine wants an EXECUTION hash for finalizedBlockHash, not the
+        # beacon checkpoint root (zeros when the finalized block is unknown
+        # or pre-merge — the engine-API's defined "none" value)
+        finalized = b"\x00" * 32
+        fin_block = self._blocks.get(bytes(state.finalized_checkpoint.root))
+        if fin_block is not None:
+            fin_payload = getattr(
+                fin_block.message.body, "execution_payload", None
+            )
+            if fin_payload is not None:
+                finalized = bytes(fin_payload.block_hash)
+        _status, payload_id = self.execution_layer.forkchoice_updated(
+            head_hash, finalized, attrs
+        )
+        if payload_id is None:
+            return None
+        return self.execution_layer.get_payload(
+            payload_id, self.ns.payload_types[fork]
+        )
+
     def produce_block_on_state(self, state, slot, randao_reveal, attestations=None,
-                               graffiti: bytes = b"\x00" * 32):
+                               graffiti: bytes = b"\x00" * 32, op_pool=None):
         spec = self.spec
         state = state.copy()
         if state.slot < slot:
@@ -1106,6 +1173,27 @@ class BeaconChain:
         )
         if sync_aggregate is not None:
             body_kwargs["sync_aggregate"] = sync_aggregate
+        if (
+            "execution_payload" in body_fields
+            and self.execution_layer is not None
+        ):
+            payload = self._produce_payload(state, slot, fork)
+            if payload is not None:
+                body_kwargs["execution_payload"] = payload
+        if op_pool is not None:
+            # pooled slashing evidence + exits (+ capella credential
+            # rotations) ride the block (get_slashings_and_exits,
+            # operation_pool/src/lib.rs:388)
+            proposer_sl, attester_sl, exits = op_pool.get_slashings_and_exits(
+                state
+            )
+            body_kwargs["proposer_slashings"] = proposer_sl
+            body_kwargs["attester_slashings"] = attester_sl
+            body_kwargs["voluntary_exits"] = exits
+            if "bls_to_execution_changes" in body_fields:
+                body_kwargs["bls_to_execution_changes"] = (
+                    op_pool.get_bls_to_execution_changes(state)
+                )
         body = body_cls(**body_kwargs)
         inner_cls = dict(block_cls.FIELDS)["message"]
         block = inner_cls(

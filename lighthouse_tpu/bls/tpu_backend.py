@@ -248,6 +248,131 @@ def verify_indexed_sets_device(cache_arr, items) -> bool:
 
 
 @functools.lru_cache(maxsize=None)
+def _sharded_gathered_kernel(mesh, n_pad: int, k_pad: int):
+    """Multi-chip twin of ``_gathered_kernel``: the FULL fused chain hot path
+    (cache gather + aggregate + device h2c + signature decompression + RLC
+    verification) data-parallel over the mesh's ``sets`` axis.
+
+    The pubkey cache is replicated (every chip holds the decompressed
+    validator registry — ``validator_pubkey_cache.rs`` parity; ~100 MB at 1M
+    validators, well within HBM); each device gathers and aggregates only its
+    n/n_dev sets, hashes its messages to G2, runs its Miller loops, and emits
+    a local pairing product + local signature partial sum. The cross-device
+    G2 MSM reduction and Fq12 product combine over ICI, then one replicated
+    final exponentiation closes the batch. Reference semantics:
+    ``crypto/bls/src/impls/blst.rs:37-119``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    from ..ops.bls import h2c
+    from .serde import raw_to_mont
+
+    def local_stage(cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf,
+                    scalars, valid):
+        mg2 = h2c.map_to_g2(u0, u1)
+        mxa, mya = g2.to_affine(mg2)
+        x_mont = raw_to_mont(jnp.stack([sxc0, sxc1], axis=-2))
+        sig, on_curve = g2.decompress(x_mont, s_flag)
+        pts = cache[idx]
+        pk_agg = curve.point_sum(
+            1, jnp.moveaxis(pts, 1, 0), jnp.moveaxis(mask, 1, 0)
+        )
+        set_ok, pk_scaled, sig_part = _set_prologue(pk_agg, sig, scalars, valid)
+        set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(mask, axis=1)))
+        pkx, pky = g1.to_affine(pk_scaled)
+        fs = pairing.miller_loop(pkx[:, 0, :], pky[:, 0, :], mxa, mya)
+        fs = tower.t_select(valid, fs, tower.one(12, fs.shape[:-2]))
+        return (
+            pairing.fq12_prod(fs)[None],
+            sig_part[None],
+            jnp.all(set_ok)[None],
+            jnp.any(valid)[None],
+        )
+
+    sharded = shard_map(
+        local_stage,
+        mesh=mesh,
+        in_specs=(P(),) + (P("sets"),) * 10,
+        out_specs=(P("sets"),) * 4,
+    )
+
+    @jax.jit
+    def verify(cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf,
+               scalars, valid):
+        partial_f, partial_sig, ok_parts, any_parts = sharded(
+            cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf,
+            scalars, valid
+        )
+        sig_acc = g2.psum(partial_sig)
+        f_all = pairing.fq12_prod(partial_f)
+        sx, sy = g2.to_affine(sig_acc)
+        f_last = pairing.miller_loop(_MG1_X, _MG1_Y, sx, sy)
+        f = tower.fq12_mul(f_all, f_last)
+        ok = tower.fq12_is_one(pairing.final_exponentiation(f))
+        return ok & jnp.all(ok_parts) & jnp.any(any_parts)
+
+    return verify
+
+
+def verify_indexed_sets_sharded(cache_arr, items, mesh) -> bool:
+    """``verify_indexed_sets_device`` over a mesh with a ``sets`` axis:
+    mainnet-shape batches (ragged per-set key counts, 0-padded to a shared
+    k bucket) data-parallel across chips, pubkey cache replicated."""
+    from .serde import parse_g2_bytes
+    from ..ops.bls import h2c
+    from ..ops.bls_oracle.ciphersuite import DST
+
+    n = len(items)
+    if n == 0:
+        return False
+    n_dev = mesh.devices.size
+    n_pad = ((bucket(max(n, n_dev)) + n_dev - 1) // n_dev) * n_dev
+    k_pad = bucket(max((len(ix) for ix, _, _ in items), default=1))
+
+    idx = np.zeros((n_pad, k_pad), dtype=np.int32)
+    mask = np.zeros((n_pad, k_pad), dtype=bool)
+    sig_bytes = np.zeros((n_pad, 96), dtype=np.uint8)
+    msgs = []
+    for i, (indices, msg, sb) in enumerate(items):
+        k = len(indices)
+        if k > 0:
+            idx[i, :k] = np.asarray(indices, dtype=np.int32)
+            mask[i, :k] = True
+        msgs.append(msg)
+        sig_bytes[i] = np.frombuffer(sb, dtype=np.uint8)
+
+    parsed = parse_g2_bytes(sig_bytes)
+    sig_wf = parsed["wf_ok"] & ~parsed["is_inf"]
+    u0, u1 = h2c.hash_to_field_batch(msgs, DST)
+    if n_pad > n:
+        u0 = jnp.concatenate(
+            [u0, jnp.broadcast_to(u0[:1], (n_pad - n,) + u0.shape[1:])]
+        )
+        u1 = jnp.concatenate(
+            [u1, jnp.broadcast_to(u1[:1], (n_pad - n,) + u1.shape[1:])]
+        )
+    scalars = np.array(
+        [secrets.randbits(RAND_BITS) or 1 for _ in range(n_pad)], dtype=np.uint64
+    )
+    valid = np.arange(n_pad) < n
+    ok = _sharded_gathered_kernel(mesh, n_pad, k_pad)(
+        cache_arr,
+        jnp.asarray(idx),
+        jnp.asarray(mask),
+        u0,
+        u1,
+        jnp.asarray(parsed["x_c0"]),
+        jnp.asarray(parsed["x_c1"]),
+        jnp.asarray(parsed["s_flag"]),
+        jnp.asarray(sig_wf),
+        jnp.asarray(scalars),
+        jnp.asarray(valid),
+    )
+    return bool(np.asarray(ok))
+
+
+@functools.lru_cache(maxsize=None)
 def _sharded_verify_kernel(mesh):
     """Multi-chip twin of ``_verify_kernel``: dp over signature sets on the
     mesh's ``sets`` axis. Each device scales its pubkeys/signatures, runs its

@@ -155,16 +155,24 @@ def gen_shuffling(root: str, config: str = "minimal") -> None:
         )
 
 
+FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+
+
+def fork_overrides(fork: str, at_epoch: int = 0) -> dict:
+    """Spec overrides activating every fork up to ``fork`` at ``at_epoch``
+    (0 = genesis-active, the per-fork vector convention)."""
+    return {f"{f}_fork_epoch": at_epoch for f in FORKS[1 : FORKS.index(fork) + 1]}
+
+
 def _harness(fork: str, n=32):
     from ..testing.harness import StateHarness
     from ..types.spec import minimal_spec
 
-    spec = minimal_spec(altair_fork_epoch=0) if fork == "altair" else minimal_spec()
-    return StateHarness(spec, n)
+    return StateHarness(minimal_spec(**fork_overrides(fork)), n)
 
 
 def gen_ssz_static(root: str, config: str = "minimal") -> None:
-    for fork in ("phase0", "altair"):
+    for fork in FORKS:
         h = _harness(fork)
         h.extend_chain(3)
         state = h.state
@@ -338,7 +346,7 @@ def gen_operations(root: str, config: str = "minimal") -> None:
 def gen_epoch_processing(root: str, config: str = "minimal") -> None:
     from ..state_transition import process_epoch, process_slots
 
-    for fork in ("phase0", "altair"):
+    for fork in FORKS:
         h = _harness(fork)
         h.extend_chain(h.spec.preset.SLOTS_PER_EPOCH + 2)
         state = h.state.copy()
@@ -355,7 +363,7 @@ def gen_epoch_processing(root: str, config: str = "minimal") -> None:
 
 
 def gen_sanity_blocks(root: str, config: str = "minimal") -> None:
-    for fork in ("phase0", "altair"):
+    for fork in FORKS:
         h = _harness(fork)
         h.extend_chain(2)
         pre = h.state.copy()
@@ -377,6 +385,449 @@ def gen_sanity_blocks(root: str, config: str = "minimal") -> None:
         _w(d, "post.ssz", state_cls.encode(h.state))
 
 
+def gen_operations_merge(root: str, config: str = "minimal") -> None:
+    """Fork-specific operation vectors: execution payloads (bellatrix),
+    withdrawals + credential rotation (capella), EL-triggered requests and
+    committee-bits attestations (electra). Mirrors the per-fork handler dirs
+    of testing/ef_tests/src/cases/operations.rs."""
+    from ..state_transition import process_slots
+    from ..types.helpers import compute_domain, compute_signing_root
+
+    # --- bellatrix: execution_payload valid + wrong-parent error twin
+    h = _harness("bellatrix")
+    h.extend_chain(3)
+    st = h.state.copy()
+    process_slots(h.spec, st, st.slot + 1)
+    state_cls = type(st)
+    payload = h._execution_payload(st, st.slot, "bellatrix")
+    payload_cls = type(payload)
+    d = _case_dir(root, config, "bellatrix", "operations", "execution_payload", 0)
+    _w(d, "pre.ssz", state_cls.encode(st))
+    _w(d, "execution_payload.ssz", payload_cls.encode(payload))
+    post = st.copy()
+    from ..state_transition.per_block import process_execution_payload
+
+    process_execution_payload(h.spec, post, payload)
+    _w(d, "post.ssz", state_cls.encode(post))
+    bad = payload_cls.decode(payload_cls.encode(payload))
+    bad.parent_hash = b"\xbe" * 32
+    d = _case_dir(root, config, "bellatrix", "operations", "execution_payload", 1)
+    _w(d, "pre.ssz", state_cls.encode(st))
+    _w(d, "execution_payload.ssz", payload_cls.encode(bad))
+    _w(d, "meta.json", {"error": True})
+
+    # --- capella: withdrawals sweep + bls_to_execution_change
+    h = _harness("capella")
+    h.extend_chain(3)
+    st = h.state.copy()
+    # give a validator inside the sweep window (the cursor advances
+    # MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP per block) an eth1 credential +
+    # excess balance -> partial withdrawal
+    wv = (int(st.next_withdrawal_validator_index) + 1) % len(st.validators)
+    st.validators[wv].withdrawal_credentials = (
+        b"\x01" + b"\x00" * 11 + b"\x11" * 20
+    )
+    st.balances[wv] = int(st.balances[wv]) + 5_000_000_000
+    state_cls = type(st)
+    from ..state_transition.per_block import (
+        _expected_withdrawals_list,
+        process_withdrawals,
+    )
+
+    ns = h.ns
+    wlist = _expected_withdrawals_list(h.spec, st)
+    assert wlist, "capella withdrawals vector needs a non-empty sweep"
+    payload = h._execution_payload(st, st.slot, "capella")
+    payload.withdrawals = wlist
+    payload_cls = type(payload)
+    d = _case_dir(root, config, "capella", "operations", "withdrawals", 0)
+    _w(d, "pre.ssz", state_cls.encode(st))
+    _w(d, "execution_payload.ssz", payload_cls.encode(payload))
+    post = st.copy()
+    process_withdrawals(h.spec, post, payload)
+    _w(d, "post.ssz", state_cls.encode(post))
+    bad = payload_cls.decode(payload_cls.encode(payload))
+    bad.withdrawals = []
+    d = _case_dir(root, config, "capella", "operations", "withdrawals", 1)
+    _w(d, "pre.ssz", state_cls.encode(st))
+    _w(d, "execution_payload.ssz", payload_cls.encode(bad))
+    _w(d, "meta.json", {"error": True})
+
+    # bls_to_execution_change: interop credentials are 0x00||sha256(pk)[1:]
+    from ..types.containers import BLSToExecutionChange, SignedBLSToExecutionChange
+
+    st2 = h.state.copy()
+    change = BLSToExecutionChange(
+        validator_index=2,
+        from_bls_pubkey=bytes(st2.validators[2].pubkey),
+        to_execution_address=b"\x22" * 20,
+    )
+    domain = compute_domain(
+        h.spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        h.spec.genesis_fork_version,
+        bytes(st2.genesis_validators_root),
+    )
+    signed = SignedBLSToExecutionChange(
+        message=change,
+        signature=h._sign(2, compute_signing_root(change, domain)),
+    )
+    d = _case_dir(root, config, "capella", "operations", "bls_to_execution_change", 0)
+    _w(d, "pre.ssz", state_cls.encode(st2))
+    _w(d, "address_change.ssz", SignedBLSToExecutionChange.encode(signed))
+    post = st2.copy()
+    from ..state_transition.per_block import process_bls_to_execution_change
+
+    process_bls_to_execution_change(h.spec, post, signed, verify=True)
+    _w(d, "post.ssz", state_cls.encode(post))
+    badsig = SignedBLSToExecutionChange(
+        message=change, signature=h._sign(3, b"\x00" * 32)
+    )
+    d = _case_dir(root, config, "capella", "operations", "bls_to_execution_change", 1)
+    _w(d, "pre.ssz", state_cls.encode(st2))
+    _w(d, "address_change.ssz", SignedBLSToExecutionChange.encode(badsig))
+    _w(d, "meta.json", {"error": True})
+
+    # --- electra: EL-triggered requests + committee-bits attestation
+    h = _harness("electra")
+    h.extend_chain(3)
+    spec = h.spec
+    ns = h.ns
+    st = h.state.copy()
+    state_cls = type(st)
+    from ..state_transition.electra import (
+        process_consolidation_request,
+        process_deposit_request,
+        process_withdrawal_request,
+    )
+
+    # deposit_request: appends to pending_deposits (EIP-6110; no failure path)
+    dreq = ns.DepositRequest(
+        pubkey=bytes(st.validators[0].pubkey),
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\x33" * 20,
+        amount=32_000_000_000,
+        signature=b"\x0a" * 96,
+        index=7,
+    )
+    d = _case_dir(root, config, "electra", "operations", "deposit_request", 0)
+    _w(d, "pre.ssz", state_cls.encode(st))
+    _w(d, "deposit_request.ssz", ns.DepositRequest.encode(dreq))
+    post = st.copy()
+    process_deposit_request(spec, post, dreq)
+    _w(d, "post.ssz", state_cls.encode(post))
+
+    # withdrawal_request full-exit: validator 4 owns an execution credential.
+    # Invalid requests are spec'd as NO-OPS (post == pre), not errors. Exit
+    # requests require shard_committee_period epochs of activity first.
+    addr = b"\x44" * 20
+    st_w = st.copy()
+    process_slots(
+        spec,
+        st_w,
+        (spec.shard_committee_period + 1) * spec.preset.SLOTS_PER_EPOCH,
+    )
+    st_w.validators[4].withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr
+    wreq = ns.WithdrawalRequest(
+        source_address=addr,
+        validator_pubkey=bytes(st_w.validators[4].pubkey),
+        amount=0,  # FULL_EXIT_REQUEST_AMOUNT
+    )
+    d = _case_dir(root, config, "electra", "operations", "withdrawal_request", 0)
+    _w(d, "pre.ssz", state_cls.encode(st_w))
+    _w(d, "withdrawal_request.ssz", ns.WithdrawalRequest.encode(wreq))
+    post = st_w.copy()
+    process_withdrawal_request(spec, post, wreq)
+    assert post.tree_root() != st_w.tree_root(), "exit request must take effect"
+    _w(d, "post.ssz", state_cls.encode(post))
+    wrong = ns.WithdrawalRequest(
+        source_address=b"\x55" * 20,
+        validator_pubkey=bytes(st_w.validators[4].pubkey),
+        amount=0,
+    )
+    d = _case_dir(root, config, "electra", "operations", "withdrawal_request", 1)
+    _w(d, "pre.ssz", state_cls.encode(st_w))
+    _w(d, "withdrawal_request.ssz", ns.WithdrawalRequest.encode(wrong))
+    _w(d, "post.ssz", state_cls.encode(st_w))  # no-op: post == pre
+
+    # consolidation_request self-switch to compounding (0x01 -> 0x02)
+    st_c = st.copy()
+    st_c.validators[5].withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr
+    creq = ns.ConsolidationRequest(
+        source_address=addr,
+        source_pubkey=bytes(st_c.validators[5].pubkey),
+        target_pubkey=bytes(st_c.validators[5].pubkey),
+    )
+    d = _case_dir(root, config, "electra", "operations", "consolidation_request", 0)
+    _w(d, "pre.ssz", state_cls.encode(st_c))
+    _w(d, "consolidation_request.ssz", ns.ConsolidationRequest.encode(creq))
+    post = st_c.copy()
+    process_consolidation_request(spec, post, creq)
+    assert post.tree_root() != st_c.tree_root(), "switch must take effect"
+    _w(d, "post.ssz", state_cls.encode(post))
+
+    # electra attestation (committee_bits + index=0 wire shape, EIP-7549)
+    prev = h.state
+    att = h.attestations_for_slot(prev, prev.slot, h.head_root(prev))[0]
+    pre = prev.copy()
+    process_slots(spec, pre, prev.slot + spec.min_attestation_inclusion_delay)
+    d = _case_dir(root, config, "electra", "operations", "attestation", 0)
+    _w(d, "pre.ssz", state_cls.encode(pre))
+    _w(d, "attestation.ssz", type(att).encode(att))
+    post = pre.copy()
+    from .handler import _op_attestation
+
+    _op_attestation(spec, post, att)
+    _w(d, "post.ssz", state_cls.encode(post))
+    badatt = type(att).decode(type(att).encode(att))
+    badatt.data.index = 3  # electra: non-zero data.index is invalid
+    d = _case_dir(root, config, "electra", "operations", "attestation", 1)
+    _w(d, "pre.ssz", state_cls.encode(pre))
+    _w(d, "attestation.ssz", type(att).encode(badatt))
+    _w(d, "meta.json", {"error": True})
+
+
+def gen_transition(root: str, config: str = "minimal") -> None:
+    """Fork-boundary vectors: start one epoch before the fork, run blocks
+    across it (cases/transition.rs). pre decodes as the old fork's state,
+    post as the new fork's; blocks switch class at the boundary slot."""
+    from ..testing.harness import StateHarness
+    from ..types.spec import minimal_spec
+
+    for i in range(1, len(FORKS)):
+        pre_fork, post_fork = FORKS[i - 1], FORKS[i]
+        overrides = fork_overrides(pre_fork)
+        overrides[f"{post_fork}_fork_epoch"] = 1
+        spec = minimal_spec(**overrides)
+        h = StateHarness(spec, 32)
+        spe = spec.preset.SLOTS_PER_EPOCH
+        h.extend_chain(2)
+        pre = h.state.copy()
+        blocks = []
+        # cross the boundary: blocks up to one slot past the fork epoch start
+        while h.state.slot < spe + 1:
+            slot = h.state.slot + 1
+            prev = h.state
+            atts = []
+            if prev.slot + spec.min_attestation_inclusion_delay <= slot:
+                atts = h.attestations_for_slot(prev, prev.slot, h.head_root(prev))
+            block = h.produce_block(slot, attestations=atts)
+            h.apply_block(block)
+            blocks.append(block)
+        d = _case_dir(root, config, post_fork, "transition", "core", 0)
+        _w(
+            d,
+            "meta.json",
+            {"pre_fork": pre_fork, "post_fork": post_fork, "fork_epoch": 1},
+        )
+        _w(d, "pre.ssz", type(pre).encode(pre))
+        for j, b in enumerate(blocks):
+            _w(d, f"blocks_{j}.ssz", type(b).encode(b))
+        _w(d, "post.ssz", type(h.state).encode(h.state))
+
+
+# deterministic insecure trusted-setup geometry shared with the handler
+KZG_SETUP_N = 8
+KZG_SETUP_G2 = 4
+KZG_CELLS = 8
+
+
+def _kzg_pair():
+    from ..kzg import Kzg
+    from ..kzg.setup import insecure_setup
+
+    kzg = Kzg(insecure_setup(KZG_SETUP_N, n_g2=KZG_SETUP_G2))
+    return kzg
+
+
+def _blob(kzg, seed: int) -> bytes:
+    from ..ops.bls_oracle.fields import R
+
+    rng = np.random.default_rng(seed)
+    out = b""
+    for _ in range(kzg.n):
+        out += (int.from_bytes(rng.bytes(31), "big") % R).to_bytes(32, "big")
+    return out
+
+
+def gen_kzg(root: str, config: str = "general") -> None:
+    """KZG vectors (cases/kzg_*.rs families), deneb blob families + fulu cell
+    families, generated from the host path and checked per backend by the
+    handler. Geometry rides an insecure deterministic setup (meta.json)."""
+    kzg = _kzg_pair()
+    meta = {"setup_n": KZG_SETUP_N, "setup_n_g2": KZG_SETUP_G2}
+    blobs = [_blob(kzg, s) for s in (1, 2, 3)]
+    comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+
+    for i, (b, c) in enumerate(zip(blobs, comms)):
+        d = _case_dir(root, config, "deneb", "kzg", "blob_to_kzg_commitment", i)
+        _w(d, "data.json", {"input": {"blob": b.hex()}, "output": c.hex(), **meta})
+
+    z = (7).to_bytes(32, "big")
+    proof, y = kzg.compute_kzg_proof(blobs[0], z)
+    d = _case_dir(root, config, "deneb", "kzg", "compute_kzg_proof", 0)
+    _w(
+        d,
+        "data.json",
+        {
+            "input": {"blob": blobs[0].hex(), "z": z.hex()},
+            "output": [proof.hex(), y.hex()],
+            **meta,
+        },
+    )
+    for i, (zv, yv, pv, ok) in enumerate(
+        [
+            (z, y, proof, True),
+            (z, (int.from_bytes(y, "big") ^ 1).to_bytes(32, "big"), proof, False),
+        ]
+    ):
+        d = _case_dir(root, config, "deneb", "kzg", "verify_kzg_proof", i)
+        _w(
+            d,
+            "data.json",
+            {
+                "input": {
+                    "commitment": comms[0].hex(),
+                    "z": zv.hex(),
+                    "y": yv.hex(),
+                    "proof": pv.hex(),
+                },
+                "output": ok,
+                **meta,
+            },
+        )
+
+    bproofs = [
+        kzg.compute_blob_kzg_proof(b, c) for b, c in zip(blobs, comms)
+    ]
+    d = _case_dir(root, config, "deneb", "kzg", "compute_blob_kzg_proof", 0)
+    _w(
+        d,
+        "data.json",
+        {
+            "input": {"blob": blobs[0].hex(), "commitment": comms[0].hex()},
+            "output": bproofs[0].hex(),
+            **meta,
+        },
+    )
+    for i, (b, c, p, ok) in enumerate(
+        [
+            (blobs[0], comms[0], bproofs[0], True),
+            (blobs[0], comms[1], bproofs[0], False),
+        ]
+    ):
+        d = _case_dir(root, config, "deneb", "kzg", "verify_blob_kzg_proof", i)
+        _w(
+            d,
+            "data.json",
+            {
+                "input": {
+                    "blob": b.hex(),
+                    "commitment": c.hex(),
+                    "proof": p.hex(),
+                },
+                "output": ok,
+                **meta,
+            },
+        )
+    for i, (bs, cs, ps, ok) in enumerate(
+        [
+            (blobs, comms, bproofs, True),
+            (blobs, comms, [bproofs[1], bproofs[0], bproofs[2]], False),
+        ]
+    ):
+        d = _case_dir(
+            root, config, "deneb", "kzg", "verify_blob_kzg_proof_batch", i
+        )
+        _w(
+            d,
+            "data.json",
+            {
+                "input": {
+                    "blobs": [b.hex() for b in bs],
+                    "commitments": [c.hex() for c in cs],
+                    "proofs": [p.hex() for p in ps],
+                },
+                "output": ok,
+                **meta,
+            },
+        )
+
+    # fulu cell families on the same setup
+    from ..kzg.cells import CellContext
+
+    ctx = CellContext(kzg, cells_per_ext_blob=KZG_CELLS)
+    meta_c = {**meta, "cells_per_ext_blob": KZG_CELLS}
+    cells, cproofs = ctx.compute_cells_and_kzg_proofs(blobs[0])
+    d = _case_dir(
+        root, config, "fulu", "kzg_cells", "compute_cells_and_kzg_proofs", 0
+    )
+    _w(
+        d,
+        "data.json",
+        {
+            "input": {"blob": blobs[0].hex()},
+            "output": [
+                [c.hex() for c in cells],
+                [p.hex() for p in cproofs],
+            ],
+            **meta_c,
+        },
+    )
+    half = list(range(0, ctx.cells, 2))
+    d = _case_dir(
+        root, config, "fulu", "kzg_cells", "recover_cells_and_kzg_proofs", 0
+    )
+    _w(
+        d,
+        "data.json",
+        {
+            "input": {
+                "cell_indices": half,
+                "cells": [cells[j].hex() for j in half],
+            },
+            "output": [
+                [c.hex() for c in cells],
+                [p.hex() for p in cproofs],
+            ],
+            **meta_c,
+        },
+    )
+    tampered = bytearray(cells[1])
+    tampered[0] ^= 1
+    for i, (idxs, cs, ps, ok) in enumerate(
+        [
+            (
+                list(range(ctx.cells)),
+                [c.hex() for c in cells],
+                [p.hex() for p in cproofs],
+                True,
+            ),
+            (
+                [0, 1],
+                [cells[0].hex(), bytes(tampered).hex()],
+                [cproofs[0].hex(), cproofs[1].hex()],
+                False,
+            ),
+        ]
+    ):
+        d = _case_dir(
+            root, config, "fulu", "kzg_cells", "verify_cell_kzg_proof_batch", i
+        )
+        _w(
+            d,
+            "data.json",
+            {
+                "input": {
+                    "commitment": comms[0].hex(),
+                    "cell_indices": idxs,
+                    "cells": cs,
+                    "proofs": ps,
+                },
+                "output": ok,
+                **meta_c,
+            },
+        )
+
+
 def main(root: str | None = None) -> None:
     from .handler import default_vector_root
 
@@ -387,8 +838,11 @@ def main(root: str | None = None) -> None:
     gen_shuffling(root)
     gen_ssz_static(root)
     gen_operations(root)
+    gen_operations_merge(root)
     gen_epoch_processing(root)
     gen_sanity_blocks(root)
+    gen_transition(root)
+    gen_kzg(root)
     n = sum(len(fs) for _, _, fs in os.walk(root))
     print(f"wrote {n} vector files under {root}")
 

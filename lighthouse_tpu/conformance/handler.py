@@ -49,33 +49,36 @@ def _ns_and_spec(config: str, fork: str):
     from ..types.containers import for_preset
     from ..types.spec import mainnet_spec, minimal_spec
 
+    from .generate import fork_overrides
+
     mk = minimal_spec if config == "minimal" else mainnet_spec
     # vectors for a fork are generated with that fork active from genesis
-    spec = mk(altair_fork_epoch=0) if fork == "altair" else mk()
+    spec = mk(**fork_overrides(fork))
     return for_preset(spec.preset.name), spec
 
 
 def _ssz_type(ns, fork: str, name: str):
     """Resolve a container class by its spec name for the given fork."""
     per_fork = {
-        "BeaconState": {"phase0": ns.BeaconState, "altair": ns.BeaconStateAltair},
-        "SignedBeaconBlock": {
-            "phase0": ns.SignedBeaconBlock,
-            "altair": ns.SignedBeaconBlockAltair,
-        },
+        "BeaconState": ns.state_types,
+        "SignedBeaconBlock": ns.block_types,
+        "Attestation": ns.attestation_types,
+        "IndexedAttestation": ns.indexed_attestation_types,
+        "AttesterSlashing": ns.attester_slashing_types,
     }
     if name in per_fork:
         return per_fork[name][fork]
     fixed = {
-        "Attestation": ns.Attestation,
-        "IndexedAttestation": ns.IndexedAttestation,
-        "AttesterSlashing": ns.AttesterSlashing,
         "AggregateAndProof": ns.AggregateAndProof,
         "SignedAggregateAndProof": ns.SignedAggregateAndProof,
         "SyncAggregate": ns.SyncAggregate,
         "SyncCommittee": ns.SyncCommittee,
+        "ExecutionPayload": ns.payload_types.get(fork),
+        "DepositRequest": getattr(ns, "DepositRequest", None),
+        "WithdrawalRequest": getattr(ns, "WithdrawalRequest", None),
+        "ConsolidationRequest": getattr(ns, "ConsolidationRequest", None),
     }
-    if name in fixed:
+    if fixed.get(name) is not None:
         return fixed[name]
     from ..types import containers as c
 
@@ -235,9 +238,46 @@ def _op_attester_slashing(spec, state, op):
     process_attester_slashing(spec, state, op, verify=True)
 
 
+def _op_execution_payload(spec, state, op):
+    from ..state_transition.per_block import process_execution_payload
+
+    process_execution_payload(spec, state, op)
+
+
+def _op_withdrawals(spec, state, op):
+    from ..state_transition.per_block import process_withdrawals
+
+    process_withdrawals(spec, state, op)
+
+
+def _op_bls_change(spec, state, op):
+    from ..state_transition.per_block import process_bls_to_execution_change
+
+    process_bls_to_execution_change(spec, state, op, verify=True)
+
+
+def _op_deposit_request(spec, state, op):
+    from ..state_transition.electra import process_deposit_request
+
+    process_deposit_request(spec, state, op)
+
+
+def _op_withdrawal_request(spec, state, op):
+    from ..state_transition.electra import process_withdrawal_request
+
+    process_withdrawal_request(spec, state, op)
+
+
+def _op_consolidation_request(spec, state, op):
+    from ..state_transition.electra import process_consolidation_request
+
+    process_consolidation_request(spec, state, op)
+
+
 def case_operations(ctx: CaseContext, config: str, fork: str, handler: str):
     """pre.ssz + <op>.ssz -> post.ssz, or meta.json {"error": true}
-    (cases/operations.rs shape)."""
+    (cases/operations.rs shape). EL-request handlers (electra) treat invalid
+    inputs as spec'd no-ops, so their "failure" vectors have post == pre."""
     from ..state_transition.per_block import BlockProcessingError
 
     ns, spec = _ns_and_spec(config, fork)
@@ -246,24 +286,39 @@ def case_operations(ctx: CaseContext, config: str, fork: str, handler: str):
     expect_error = ctx.has("meta.json") and ctx.json("meta.json").get("error")
 
     op_files = {
-        "attestation": ("attestation.ssz", ns.Attestation, _op_attestation),
+        "attestation": ("attestation.ssz", "Attestation", _op_attestation),
         "voluntary_exit": (
-            "voluntary_exit.ssz",
-            _ssz_type(ns, fork, "SignedVoluntaryExit"),
-            _op_exit,
+            "voluntary_exit.ssz", "SignedVoluntaryExit", _op_exit,
         ),
         "proposer_slashing": (
-            "proposer_slashing.ssz",
-            _ssz_type(ns, fork, "ProposerSlashing"),
-            _op_proposer_slashing,
+            "proposer_slashing.ssz", "ProposerSlashing", _op_proposer_slashing,
         ),
         "attester_slashing": (
-            "attester_slashing.ssz",
-            ns.AttesterSlashing,
-            _op_attester_slashing,
+            "attester_slashing.ssz", "AttesterSlashing", _op_attester_slashing,
+        ),
+        "execution_payload": (
+            "execution_payload.ssz", "ExecutionPayload", _op_execution_payload,
+        ),
+        "withdrawals": (
+            "execution_payload.ssz", "ExecutionPayload", _op_withdrawals,
+        ),
+        "bls_to_execution_change": (
+            "address_change.ssz", "SignedBLSToExecutionChange", _op_bls_change,
+        ),
+        "deposit_request": (
+            "deposit_request.ssz", "DepositRequest", _op_deposit_request,
+        ),
+        "withdrawal_request": (
+            "withdrawal_request.ssz", "WithdrawalRequest",
+            _op_withdrawal_request,
+        ),
+        "consolidation_request": (
+            "consolidation_request.ssz", "ConsolidationRequest",
+            _op_consolidation_request,
         ),
     }
-    fname, op_cls, op_fn = op_files[handler]
+    fname, cls_name, op_fn = op_files[handler]
+    op_cls = _ssz_type(ns, fork, cls_name)
     op = op_cls.decode(ctx.read(fname))
     try:
         op_fn(spec, state, op)
@@ -318,6 +373,137 @@ def case_sanity_blocks(ctx: CaseContext, config: str, fork: str, handler: str):
         raise ConformanceError(f"{ctx.path}: sanity post-state mismatch")
 
 
+def case_transition(ctx: CaseContext, config: str, fork: str, handler: str):
+    """Cross-fork chain: pre decodes as the old fork's state, blocks switch
+    class at the boundary, post decodes as the new fork's state
+    (cases/transition.rs)."""
+    from ..state_transition import (
+        BlockSignatureStrategy,
+        per_block_processing,
+        process_slots,
+    )
+    from ..types.containers import for_preset
+    from ..types.spec import mainnet_spec, minimal_spec
+
+    from .generate import fork_overrides
+
+    meta = ctx.json("meta.json")
+    pre_fork, fork_epoch = meta["pre_fork"], meta["fork_epoch"]
+    overrides = fork_overrides(pre_fork)
+    overrides[f"{fork}_fork_epoch"] = fork_epoch
+    mk = minimal_spec if config == "minimal" else mainnet_spec
+    spec = mk(**overrides)
+    ns = for_preset(spec.preset.name)
+    state = ns.state_types[pre_fork].decode(ctx.read("pre.ssz"))
+    i = 0
+    while ctx.has(f"blocks_{i}.ssz"):
+        raw = ctx.read(f"blocks_{i}.ssz")
+        # the block's slot (bytes 100..108 of any SignedBeaconBlock: 4-byte
+        # message offset + 96-byte signature, then the fixed slot field)
+        slot = int.from_bytes(raw[100:108], "little")
+        block_fork = spec.fork_name_at_epoch(spec.compute_epoch_at_slot(slot))
+        sb = ns.block_types[block_fork].decode(raw)
+        if state.slot < sb.message.slot:
+            process_slots(spec, state, sb.message.slot)
+        per_block_processing(
+            spec, state, sb, strategy=BlockSignatureStrategy.VERIFY_BULK
+        )
+        i += 1
+    post = ns.state_types[fork].decode(ctx.read("post.ssz"))
+    if state.tree_root() != post.tree_root():
+        raise ConformanceError(f"{ctx.path}: transition post-state mismatch")
+
+
+def _kzg_from_meta(data: dict):
+    from ..kzg import Kzg
+    from ..kzg.setup import insecure_setup
+
+    return Kzg(insecure_setup(data["setup_n"], n_g2=data["setup_n_g2"]))
+
+
+def case_kzg(ctx: CaseContext, config: str, fork: str, handler: str):
+    """Deneb blob families (cases/kzg_*.rs) on the vector's setup geometry."""
+    from ..kzg import KzgError
+
+    data = ctx.json("data.json")
+    kzg = _kzg_from_meta(data)
+    inp, expected = data["input"], data["output"]
+    try:
+        if handler == "blob_to_kzg_commitment":
+            got = kzg.blob_to_kzg_commitment(bytes.fromhex(inp["blob"])).hex()
+        elif handler == "compute_kzg_proof":
+            proof, y = kzg.compute_kzg_proof(
+                bytes.fromhex(inp["blob"]), bytes.fromhex(inp["z"])
+            )
+            got = [proof.hex(), y.hex()]
+        elif handler == "verify_kzg_proof":
+            got = kzg.verify_kzg_proof(
+                bytes.fromhex(inp["commitment"]),
+                bytes.fromhex(inp["z"]),
+                bytes.fromhex(inp["y"]),
+                bytes.fromhex(inp["proof"]),
+            )
+        elif handler == "compute_blob_kzg_proof":
+            got = kzg.compute_blob_kzg_proof(
+                bytes.fromhex(inp["blob"]), bytes.fromhex(inp["commitment"])
+            ).hex()
+        elif handler == "verify_blob_kzg_proof":
+            got = kzg.verify_blob_kzg_proof(
+                bytes.fromhex(inp["blob"]),
+                bytes.fromhex(inp["commitment"]),
+                bytes.fromhex(inp["proof"]),
+            )
+        elif handler == "verify_blob_kzg_proof_batch":
+            got = kzg.verify_blob_kzg_proof_batch(
+                [bytes.fromhex(b) for b in inp["blobs"]],
+                [bytes.fromhex(c) for c in inp["commitments"]],
+                [bytes.fromhex(p) for p in inp["proofs"]],
+            )
+        else:
+            raise ConformanceError(f"unknown kzg handler {handler}")
+    except KzgError:
+        got = False
+    if got != expected:
+        raise ConformanceError(f"{ctx.path}: kzg/{handler} mismatch")
+
+
+def case_kzg_cells(ctx: CaseContext, config: str, fork: str, handler: str):
+    """Fulu/PeerDAS cell families on the vector's setup geometry."""
+    from ..kzg import KzgError
+    from ..kzg.cells import CellContext
+
+    data = ctx.json("data.json")
+    cc = CellContext(
+        _kzg_from_meta(data), cells_per_ext_blob=data["cells_per_ext_blob"]
+    )
+    inp, expected = data["input"], data["output"]
+    try:
+        if handler == "compute_cells_and_kzg_proofs":
+            cells, proofs = cc.compute_cells_and_kzg_proofs(
+                bytes.fromhex(inp["blob"])
+            )
+            got = [[c.hex() for c in cells], [p.hex() for p in proofs]]
+        elif handler == "recover_cells_and_kzg_proofs":
+            cells, proofs = cc.recover_cells_and_kzg_proofs(
+                inp["cell_indices"],
+                [bytes.fromhex(c) for c in inp["cells"]],
+            )
+            got = [[c.hex() for c in cells], [p.hex() for p in proofs]]
+        elif handler == "verify_cell_kzg_proof_batch":
+            got = cc.verify_cell_kzg_proof_batch(
+                [bytes.fromhex(inp["commitment"])] * len(inp["cell_indices"]),
+                inp["cell_indices"],
+                [bytes.fromhex(c) for c in inp["cells"]],
+                [bytes.fromhex(p) for p in inp["proofs"]],
+            )
+        else:
+            raise ConformanceError(f"unknown kzg_cells handler {handler}")
+    except KzgError:
+        got = False
+    if got != expected:
+        raise ConformanceError(f"{ctx.path}: kzg_cells/{handler} mismatch")
+
+
 _RUNNERS = {
     "ssz_static": case_ssz_static,
     "shuffling": case_shuffling,
@@ -325,6 +511,9 @@ _RUNNERS = {
     "operations": case_operations,
     "epoch_processing": case_epoch_processing,
     "sanity_blocks": case_sanity_blocks,
+    "transition": case_transition,
+    "kzg": case_kzg,
+    "kzg_cells": case_kzg_cells,
 }
 
 

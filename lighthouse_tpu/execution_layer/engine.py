@@ -30,12 +30,13 @@ class PayloadStatusV1:
 
 @dataclass
 class PayloadAttributes:
-    """forkchoiceUpdated payload-build request (PayloadAttributesV2)."""
+    """forkchoiceUpdated payload-build request (PayloadAttributesV2/V3)."""
 
     timestamp: int
     prev_randao: bytes
     suggested_fee_recipient: bytes = b"\x00" * 20
     withdrawals: list | None = None  # capella+
+    parent_beacon_block_root: bytes | None = None  # deneb+ (V3)
 
 
 class ExecutionEngine:

@@ -179,6 +179,8 @@ def attributes_to_json(attrs: PayloadAttributes) -> dict:
             }
             for w in attrs.withdrawals
         ]
+    if attrs.parent_beacon_block_root is not None:
+        out["parentBeaconBlockRoot"] = data(attrs.parent_beacon_block_root)
     return out
 
 
@@ -312,8 +314,11 @@ class HttpExecutionEngine(ExecutionEngine):
         payload_attributes: PayloadAttributes | None = None,
     ) -> tuple[PayloadStatusV1, bytes | None]:
         version = 1
-        if payload_attributes is not None and payload_attributes.withdrawals is not None:
-            version = 2
+        if payload_attributes is not None:
+            if payload_attributes.parent_beacon_block_root is not None:
+                version = 3  # Cancun: V3 required post-deneb (-38005 on V2)
+            elif payload_attributes.withdrawals is not None:
+                version = 2
         method = self._pick("engine_forkchoiceUpdated", version)
         state = {
             "headBlockHash": data(head_block_hash),

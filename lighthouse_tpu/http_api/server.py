@@ -50,6 +50,13 @@ class BeaconApiServer:
         self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        # blinded flow: payloads produced here, awaited by publication
+        # (execution_layer payload cache parity), keyed by block_hash;
+        # bounded — publication pops, unclaimed entries age out FIFO
+        from collections import OrderedDict
+
+        self._payload_cache: "OrderedDict[bytes, object]" = OrderedDict()
+        self._payload_cache_size = 8
         # Share the CHAIN's mutation lock so handler threads serialize
         # against every other driver of this chain (network router,
         # simulator loops), not just each other.
@@ -129,18 +136,165 @@ class BeaconApiServer:
             "finalized": cp(st.finalized_checkpoint),
         }
 
-    def get_validators(self, state_id: str):
-        st = self._state(state_id)
-        out = []
-        for i, v in enumerate(st.validators):
-            out.append(
-                {
-                    "index": str(i),
-                    "balance": str(int(st.balances[i])),
-                    "status": "active_ongoing",
-                    "validator": {"pubkey": _hex(v.pubkey)},
-                }
+    @staticmethod
+    def _validator_status(v, epoch: int, far: int) -> str:
+        """Beacon-API validator status taxonomy (validator/mod.rs
+        ValidatorStatus)."""
+        if int(v.activation_epoch) > epoch:
+            return (
+                "pending_queued"
+                if int(v.activation_eligibility_epoch) <= epoch
+                else "pending_initialized"
             )
+        if epoch < int(v.exit_epoch):
+            if int(v.exit_epoch) != far:
+                return "active_exiting"
+            return "active_slashed" if v.slashed else "active_ongoing"
+        if epoch < int(v.withdrawable_epoch):
+            return "exited_slashed" if v.slashed else "exited_unslashed"
+        return "withdrawal_possible"
+
+    def _validator_entry(self, st, i: int, epoch: int, far: int) -> dict:
+        v = st.validators[i]
+        return {
+            "index": str(i),
+            "balance": str(int(st.balances[i])),
+            "status": self._validator_status(v, epoch, far),
+            "validator": {
+                "pubkey": _hex(v.pubkey),
+                "withdrawal_credentials": _hex(v.withdrawal_credentials),
+                "effective_balance": str(int(v.effective_balance)),
+                "slashed": bool(v.slashed),
+                "activation_eligibility_epoch": str(
+                    int(v.activation_eligibility_epoch)
+                ),
+                "activation_epoch": str(int(v.activation_epoch)),
+                "exit_epoch": str(int(v.exit_epoch)),
+                "withdrawable_epoch": str(int(v.withdrawable_epoch)),
+            },
+        }
+
+    def _resolve_validator_index(self, st, vid: str) -> int:
+        if vid.startswith("0x"):
+            pk = _unhex(vid)
+            # O(1) via the chain's pubkey index; linear fallback only for
+            # keys the cache hasn't imported yet
+            idx = self.chain.pubkey_cache.get_index(pk)
+            if idx is not None and idx < len(st.validators):
+                return idx
+            for i, v in enumerate(st.validators):
+                if bytes(v.pubkey) == pk:
+                    return i
+            raise ApiError(404, f"no validator with pubkey {vid[:18]}…")
+        if not vid.isdigit():
+            raise ApiError(400, f"bad validator id {vid!r}")
+        i = int(vid)
+        if i >= len(st.validators):
+            raise ApiError(404, f"validator index {i} out of range")
+        return i
+
+    def get_validators(self, state_id: str, ids: str | None = None):
+        from ..types.spec import FAR_FUTURE_EPOCH
+
+        st = self._state(state_id)
+        spec = self.chain.spec
+        epoch = int(st.slot) // spec.preset.SLOTS_PER_EPOCH
+        if ids:
+            indices = [
+                self._resolve_validator_index(st, x)
+                for x in ids.split(",")
+                if x
+            ]
+        else:
+            indices = range(len(st.validators))
+        return [
+            self._validator_entry(st, i, epoch, FAR_FUTURE_EPOCH)
+            for i in indices
+        ]
+
+    def get_validator(self, state_id: str, vid: str):
+        from ..types.spec import FAR_FUTURE_EPOCH
+
+        st = self._state(state_id)
+        spec = self.chain.spec
+        epoch = int(st.slot) // spec.preset.SLOTS_PER_EPOCH
+        i = self._resolve_validator_index(st, vid)
+        return self._validator_entry(st, i, epoch, FAR_FUTURE_EPOCH)
+
+    def get_validator_balances(self, state_id: str, ids: str | None = None):
+        st = self._state(state_id)
+        if ids:
+            indices = [
+                self._resolve_validator_index(st, x)
+                for x in ids.split(",")
+                if x
+            ]
+        else:
+            indices = range(len(st.validators))
+        return [
+            {"index": str(i), "balance": str(int(st.balances[i]))}
+            for i in indices
+        ]
+
+    def get_committees(self, state_id: str, q: dict):
+        """GET /eth/v1/beacon/states/{id}/committees with epoch/index/slot
+        filters (http_api committees endpoint)."""
+        st = self._state(state_id)
+        spec = self.chain.spec
+        epoch = int(
+            q.get("epoch", int(st.slot) // spec.preset.SLOTS_PER_EPOCH)
+        )
+        state = st
+        start = spec.start_slot(epoch)
+        if state.slot < start:
+            state = state.copy()
+            process_slots(spec, state, start)
+        want_slot = int(q["slot"]) if "slot" in q else None
+        want_index = int(q["index"]) if "index" in q else None
+        out = []
+        per_slot = get_committee_count_per_slot(spec, state, epoch)
+        for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
+            if want_slot is not None and slot != want_slot:
+                continue
+            for index in range(per_slot):
+                if want_index is not None and index != want_index:
+                    continue
+                committee = get_beacon_committee(spec, state, slot, index)
+                out.append(
+                    {
+                        "index": str(index),
+                        "slot": str(slot),
+                        "validators": [str(int(v)) for v in committee],
+                    }
+                )
+        return out
+
+    def get_randao(self, state_id: str, q: dict):
+        from ..state_transition import get_randao_mix
+
+        st = self._state(state_id)
+        spec = self.chain.spec
+        epoch = int(
+            q.get("epoch", int(st.slot) // spec.preset.SLOTS_PER_EPOCH)
+        )
+        return {"randao": _hex(get_randao_mix(spec, st, epoch))}
+
+    def get_blob_sidecars(self, block_id: str, q: dict):
+        """GET /eth/v1/beacon/blob_sidecars/{block_id} from the blobs
+        column (hot_cold_store.rs get_blobs)."""
+        root = self._block_root_of(block_id)
+        raws = self.chain.store.get_blob_sidecars(root)
+        if raws is None:
+            return []
+        indices = (
+            {int(x) for x in q["indices"].split(",")} if "indices" in q else None
+        )
+        cls = self.chain.ns.BlobSidecar
+        out = []
+        for raw in raws:
+            sc = cls.decode(raw)
+            if indices is None or int(sc.index) in indices:
+                out.append(_hex(raw))
         return out
 
     def get_syncing(self):
@@ -333,7 +487,7 @@ class BeaconApiServer:
         atts = self.op_pool.get_attestations(state) if self.op_pool else []
         block, _post = chain.produce_block_on_state(
             state, slot, randao_reveal, attestations=atts,
-            graffiti=graffiti or b"\x00" * 32,
+            graffiti=graffiti or b"\x00" * 32, op_pool=self.op_pool,
         )
         fork = chain.spec.fork_name_at_epoch(
             slot // chain.spec.preset.SLOTS_PER_EPOCH
@@ -343,6 +497,62 @@ class BeaconApiServer:
             "version": fork,
             "data": _hex(inner_cls.encode(block)),
         }
+
+    def produce_blinded_block(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes
+    ):
+        """GET /eth/v1/validator/blinded_blocks/{slot}: full production,
+        payload swapped for its header; the payload is cached for
+        publication (execution_layer blinded flow — the builder seam)."""
+        from ..types.blinded import blind_signed_block
+
+        full = self.produce_block(slot, randao_reveal, graffiti)
+        fork = full["version"]
+        if fork not in self.chain.ns.payload_header_types:
+            raise ApiError(400, f"no blinded flow before bellatrix ({fork})")
+        chain = self.chain
+        inner_cls = dict(chain.ns.block_types[fork].FIELDS)["message"]
+        block = inner_cls.decode(_unhex(full["data"]))
+        payload = block.body.execution_payload
+        self._payload_cache[bytes(payload.block_hash)] = payload
+        while len(self._payload_cache) > self._payload_cache_size:
+            self._payload_cache.popitem(last=False)
+        signed_shell = chain.ns.block_types[fork](
+            message=block, signature=b"\x00" * 96
+        )
+        blinded = blind_signed_block(chain.ns, fork, signed_shell)
+        inner_blinded = blinded.message
+        return {
+            "version": fork,
+            "data": _hex(type(inner_blinded).encode(inner_blinded)),
+        }
+
+    def publish_blinded_block(self, body: dict):
+        """POST /eth/v1/beacon/blinded_blocks: reconstruct the full block
+        from the cached payload (publish_blocks.rs blinded path) and import."""
+        from ..types.blinded import blinded_types, unblind_signed_block
+
+        chain = self.chain
+        fork = body.get("version") or chain.spec.fork_name_at_slot(
+            chain.current_slot()
+        )
+        ns = blinded_types(chain.ns)
+        if fork not in ns.blinded_block_types:
+            raise ApiError(400, f"no blinded flow before bellatrix ({fork})")
+        signed_blinded = ns.blinded_block_types[fork].decode(
+            _unhex(body["data"])
+        )
+        hdr = signed_blinded.message.body.execution_payload_header
+        payload = self._payload_cache.pop(bytes(hdr.block_hash), None)
+        if payload is None:
+            raise ApiError(400, "unknown payload for blinded block")
+        try:
+            full = unblind_signed_block(ns, fork, signed_blinded, payload)
+        except ValueError as e:
+            raise ApiError(400, str(e)) from None
+        return self.publish_block(
+            {"version": fork, "data": _hex(type(full).encode(full))}
+        )
 
     def publish_block(self, body: dict):
         version = body.get("version", None)
@@ -423,40 +633,46 @@ class BeaconApiServer:
                 continue
         return None
 
-    def get_block(self, block_id: str):
-        """Signed block by 'head', slot number, or 0x-root (fork-versioned
-        SSZ envelope; /eth/v2/beacon/blocks/{block_id})."""
+    def _block_root_of(self, block_id: str) -> bytes:
+        """Resolve 'head'/'finalized'/slot/0x-root to a canonical block
+        root."""
         chain = self.chain
         if block_id == "head":
-            root = chain.head.root
-        elif block_id.startswith("0x"):
-            root = _unhex(block_id)
-        elif block_id.isdigit():
+            return chain.head.root
+        if block_id == "finalized":
+            root = bytes(
+                chain.head.state.finalized_checkpoint.root
+            )
+            return root if root != b"\x00" * 32 else chain.genesis_block_root
+        if block_id == "genesis":
+            return chain.genesis_block_root
+        if block_id.startswith("0x"):
+            return _unhex(block_id)
+        if block_id.isdigit():
             # canonical walk from head, bounded by the head slot; store
             # fallback covers migrated (finalized) history
             want = int(block_id)
             if want > chain.head.slot:
                 raise ApiError(404, f"no canonical block at slot {want}")
             root = chain.head.root
-            found = None
             while root is not None:
                 sb = self._signed_block(root)
                 if sb is None:
                     break
                 s = int(sb.message.slot)
                 if s == want:
-                    found = root
-                    break
-                if s < want:
-                    break
-                if root == chain.genesis_block_root:
+                    return root
+                if s < want or root == chain.genesis_block_root:
                     break
                 root = bytes(sb.message.parent_root)
-            if found is None:
-                raise ApiError(404, f"no canonical block at slot {want}")
-            root = found
-        else:
-            raise ApiError(400, f"unsupported block id {block_id!r}")
+            raise ApiError(404, f"no canonical block at slot {want}")
+        raise ApiError(400, f"unsupported block id {block_id!r}")
+
+    def get_block(self, block_id: str):
+        """Signed block by id (fork-versioned SSZ envelope;
+        /eth/v2/beacon/blocks/{block_id})."""
+        chain = self.chain
+        root = self._block_root_of(block_id)
         sb = self._signed_block(root)
         if sb is None:
             raise ApiError(404, f"block {root.hex()[:16]} not held")
@@ -464,11 +680,230 @@ class BeaconApiServer:
         cls = chain.ns.block_types[fork]
         return {"version": fork, "data": _hex(cls.encode(sb))}
 
-    def get_header(self):
-        head = self.chain.head
+    def get_block_root(self, block_id: str):
+        return {"root": _hex(self._block_root_of(block_id))}
+
+    def get_header(self, block_id: str = "head"):
+        root = self._block_root_of(block_id)
+        sb = self._signed_block(root)
+        if sb is not None:
+            msg = sb.message
+            fields = {
+                "slot": str(int(msg.slot)),
+                "proposer_index": str(int(msg.proposer_index)),
+                "parent_root": _hex(msg.parent_root),
+                "state_root": _hex(msg.state_root),
+                "body_root": _hex(type(msg.body).hash_tree_root(msg.body)),
+            }
+            sig = _hex(sb.signature)
+        else:
+            # anchor-state head (checkpoint sync): the block body is not
+            # held; the state's latest header carries the message fields
+            head = self.chain.head
+            if root != head.root:
+                raise ApiError(404, f"block {root.hex()[:16]} not held")
+            hdr = head.state.latest_block_header.copy()
+            if bytes(hdr.state_root) == b"\x00" * 32:
+                hdr.state_root = head.state.tree_root()
+            fields = {
+                "slot": str(int(hdr.slot)),
+                "proposer_index": str(int(hdr.proposer_index)),
+                "parent_root": _hex(hdr.parent_root),
+                "state_root": _hex(hdr.state_root),
+                "body_root": _hex(hdr.body_root),
+            }
+            sig = _hex(b"\x00" * 96)
         return {
-            "root": _hex(head.root),
-            "header": {"slot": str(head.slot)},
+            "root": _hex(root),
+            "canonical": True,
+            "header": {"message": fields, "signature": sig},
+        }
+
+    # -- pool endpoints ----------------------------------------------------
+
+    def get_pool_attester_slashings(self):
+        pool = self.op_pool
+        items = list(pool._attester_slashings) if pool else []
+        return [_hex(type(s).encode(s)) for s in items]
+
+    def get_pool_proposer_slashings(self):
+        pool = self.op_pool
+        items = list(pool._proposer_slashings.values()) if pool else []
+        return [_hex(type(s).encode(s)) for s in items]
+
+    def get_pool_voluntary_exits(self):
+        pool = self.op_pool
+        items = list(pool._voluntary_exits.values()) if pool else []
+        return [_hex(type(s).encode(s)) for s in items]
+
+    def get_pool_bls_changes(self):
+        pool = self.op_pool
+        items = list(pool._bls_changes.values()) if pool else []
+        return [_hex(type(s).encode(s)) for s in items]
+
+    def _verify_op_on_head(self, apply_fn, *args):
+        """Run an operation's full verification against a head-state copy
+        (verify_operation.rs SigVerifiedOp semantics: pool admission re-runs
+        the state checks + signature)."""
+        from ..state_transition.per_block import BlockProcessingError
+
+        state = self.chain.head.state.copy()
+        try:
+            apply_fn(state, *args)
+        except BlockProcessingError as e:
+            raise ApiError(400, str(e)) from None
+
+    def post_pool_attester_slashing(self, body: dict):
+        from ..state_transition.per_block import process_attester_slashing
+
+        ns = self.chain.ns
+        fork = self.chain.spec.fork_name_at_slot(self.chain.current_slot())
+        cls = ns.attester_slashing_types[fork]
+        sl = cls.decode(_unhex(body["data"]))
+        self._verify_op_on_head(
+            lambda st: process_attester_slashing(
+                self.chain.spec, st, sl, verify=True
+            )
+        )
+        if self.op_pool is not None:
+            self.op_pool.insert_attester_slashing(sl)
+        return {}
+
+    def post_pool_proposer_slashing(self, body: dict):
+        from ..state_transition.per_block import (
+            ConsensusContext,
+            process_proposer_slashing,
+        )
+
+        from ..types.containers import ProposerSlashing
+
+        sl = ProposerSlashing.decode(_unhex(body["data"]))
+        self._verify_op_on_head(
+            lambda st: process_proposer_slashing(
+                self.chain.spec, st, sl, ConsensusContext(), verify=True
+            )
+        )
+        if self.op_pool is not None:
+            self.op_pool.insert_proposer_slashing(sl)
+        return {}
+
+    def post_pool_voluntary_exit(self, body: dict):
+        from ..state_transition.per_block import process_exit
+
+        from ..types.containers import SignedVoluntaryExit
+
+        ex = SignedVoluntaryExit.decode(_unhex(body["data"]))
+        self._verify_op_on_head(
+            lambda st: process_exit(self.chain.spec, st, ex, verify=True)
+        )
+        if self.op_pool is not None:
+            self.op_pool.insert_voluntary_exit(ex)
+        return {}
+
+    def post_pool_bls_change(self, body: dict):
+        from ..state_transition.per_block import (
+            process_bls_to_execution_change,
+        )
+        from ..types.containers import SignedBLSToExecutionChange
+
+        ch = SignedBLSToExecutionChange.decode(_unhex(body["data"]))
+        self._verify_op_on_head(
+            lambda st: process_bls_to_execution_change(
+                self.chain.spec, st, ch, verify=True
+            )
+        )
+        if self.op_pool is not None:
+            self.op_pool.insert_bls_to_execution_change(ch)
+        return {}
+
+    # -- node / config -----------------------------------------------------
+
+    def get_node_identity(self):
+        net = self.network
+        peer_id = ""
+        addrs = []
+        if net is not None:
+            transport = getattr(net, "transport", None)
+            if transport is not None:
+                peer_id = str(getattr(transport, "node_id", ""))
+                addr = getattr(transport, "address", None)
+                if addr:
+                    addrs = [f"/ip4/{addr[0]}/tcp/{addr[1]}"]
+        return {
+            "peer_id": peer_id,
+            "enr": "",
+            "p2p_addresses": addrs,
+            "discovery_addresses": [],
+            "metadata": {"seq_number": "0", "attnets": "0x00"},
+        }
+
+    def get_node_peers(self):
+        net = self.network
+        out = []
+        if net is not None:
+            transport = getattr(net, "transport", None)
+            if transport is not None:
+                for p in transport.peers():
+                    out.append(
+                        {
+                            "peer_id": str(p),
+                            "state": "connected",
+                            "direction": "outbound",
+                        }
+                    )
+        return out
+
+    def node_health_code(self) -> int:
+        head = self.chain.head.slot
+        current = self.chain.current_slot()
+        return 206 if current > head + 1 else 200
+
+    def get_config_spec(self):
+        spec = self.chain.spec
+        p = spec.preset
+        out = {
+            "PRESET_BASE": p.name,
+            "SECONDS_PER_SLOT": str(p.SECONDS_PER_SLOT),
+            "SLOTS_PER_EPOCH": str(p.SLOTS_PER_EPOCH),
+            "MAX_COMMITTEES_PER_SLOT": str(p.MAX_COMMITTEES_PER_SLOT),
+            "MAX_EFFECTIVE_BALANCE": str(spec.max_effective_balance),
+            "MIN_ATTESTATION_INCLUSION_DELAY": str(
+                spec.min_attestation_inclusion_delay
+            ),
+            "SHARD_COMMITTEE_PERIOD": str(spec.shard_committee_period),
+            "GENESIS_FORK_VERSION": _hex(spec.genesis_fork_version),
+        }
+        for fork in ("altair", "bellatrix", "capella", "deneb", "electra"):
+            out[f"{fork.upper()}_FORK_EPOCH"] = str(spec.fork_epoch(fork))
+            out[f"{fork.upper()}_FORK_VERSION"] = _hex(
+                spec.fork_version(fork)
+            )
+        return out
+
+    def get_fork_schedule(self):
+        spec = self.chain.spec
+        out = []
+        prev = spec.genesis_fork_version
+        for fork in ("phase0", "altair", "bellatrix", "capella", "deneb",
+                     "electra"):
+            epoch = 0 if fork == "phase0" else spec.fork_epoch(fork)
+            version = spec.fork_version(fork)
+            out.append(
+                {
+                    "previous_version": _hex(prev),
+                    "current_version": _hex(version),
+                    "epoch": str(epoch),
+                }
+            )
+            prev = version
+        return out
+
+    def get_deposit_contract(self):
+        spec = self.chain.spec
+        return {
+            "chain_id": str(getattr(spec, "deposit_chain_id", 0)),
+            "address": _hex(getattr(spec, "deposit_contract_address",
+                                    b"\x00" * 20)),
         }
 
 
@@ -503,15 +938,37 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
         "finality",
     ),
     ("GET", re.compile(r"^/eth/v1/beacon/states/(\w+)/validators$"), "validators"),
+    ("GET", re.compile(r"^/eth/v1/beacon/states/(\w+)/validators/([0-9a-zA-Zx]+)$"), "validator"),
+    ("GET", re.compile(r"^/eth/v1/beacon/states/(\w+)/validator_balances$"), "validator_balances"),
+    ("GET", re.compile(r"^/eth/v1/beacon/states/(\w+)/committees$"), "committees"),
+    ("GET", re.compile(r"^/eth/v1/beacon/states/(\w+)/randao$"), "randao"),
+    ("GET", re.compile(r"^/eth/v1/beacon/blob_sidecars/(\w+|0x[0-9a-fA-F]{64})$"), "blob_sidecars"),
     ("GET", re.compile(r"^/eth/v1/node/syncing$"), "syncing"),
     ("GET", re.compile(r"^/eth/v1/node/version$"), "version"),
+    ("GET", re.compile(r"^/eth/v1/node/health$"), "health"),
+    ("GET", re.compile(r"^/eth/v1/node/identity$"), "identity"),
+    ("GET", re.compile(r"^/eth/v1/node/peers$"), "peers"),
+    ("GET", re.compile(r"^/eth/v1/config/spec$"), "config_spec"),
+    ("GET", re.compile(r"^/eth/v1/config/fork_schedule$"), "fork_schedule"),
+    ("GET", re.compile(r"^/eth/v1/config/deposit_contract$"), "deposit_contract"),
     ("GET", re.compile(r"^/eth/v1/validator/duties/proposer/(\d+)$"), "proposer"),
     ("POST", re.compile(r"^/eth/v1/validator/duties/attester/(\d+)$"), "attester"),
     ("GET", re.compile(r"^/eth/v1/validator/attestation_data$"), "att_data"),
     ("GET", re.compile(r"^/eth/v2/validator/blocks/(\d+)$"), "produce_block"),
+    ("GET", re.compile(r"^/eth/v1/validator/blinded_blocks/(\d+)$"), "produce_blinded"),
     ("POST", re.compile(r"^/eth/v1/beacon/blocks$"), "publish_block"),
+    ("POST", re.compile(r"^/eth/v1/beacon/blinded_blocks$"), "publish_blinded"),
     ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_atts"),
-    ("GET", re.compile(r"^/eth/v1/beacon/headers/head$"), "header"),
+    ("GET", re.compile(r"^/eth/v1/beacon/pool/attester_slashings$"), "pool_att_slashings"),
+    ("POST", re.compile(r"^/eth/v1/beacon/pool/attester_slashings$"), "post_att_slashing"),
+    ("GET", re.compile(r"^/eth/v1/beacon/pool/proposer_slashings$"), "pool_prop_slashings"),
+    ("POST", re.compile(r"^/eth/v1/beacon/pool/proposer_slashings$"), "post_prop_slashing"),
+    ("GET", re.compile(r"^/eth/v1/beacon/pool/voluntary_exits$"), "pool_exits"),
+    ("POST", re.compile(r"^/eth/v1/beacon/pool/voluntary_exits$"), "post_exit"),
+    ("GET", re.compile(r"^/eth/v1/beacon/pool/bls_to_execution_changes$"), "pool_bls_changes"),
+    ("POST", re.compile(r"^/eth/v1/beacon/pool/bls_to_execution_changes$"), "post_bls_change"),
+    ("GET", re.compile(r"^/eth/v1/beacon/headers/(\w+|0x[0-9a-fA-F]{64})$"), "header"),
+    ("GET", re.compile(r"^/eth/v1/beacon/blocks/(\w+|0x[0-9a-fA-F]{64})/root$"), "block_root"),
     ("GET", re.compile(r"^/eth/v1/events$"), "events"),
     ("POST", re.compile(r"^/eth/v1/validator/liveness/(\d+)$"), "liveness"),
     ("POST", re.compile(r"^/eth/v1/validator/duties/sync/(\d+)$"), "sync_duties"),
@@ -520,7 +977,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/eth/v1/validator/aggregate_attestation$"), "aggregate_att"),
     ("POST", re.compile(r"^/eth/v1/validator/aggregate_and_proofs$"), "publish_aggregates"),
     ("GET", re.compile(r"^/eth/v2/debug/beacon/states/(head|justified|finalized)$"), "debug_state"),
-    ("GET", re.compile(r"^/eth/v2/beacon/blocks/(\w+)$"), "block"),
+    ("GET", re.compile(r"^/eth/v2/beacon/blocks/(\w+|0x[0-9a-fA-F]{64})$"), "block"),
     ("GET", re.compile(r"^/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-fA-F]{64})$"), "lc_bootstrap"),
     ("GET", re.compile(r"^/eth/v1/beacon/light_client/optimistic_update$"), "lc_optimistic"),
     ("GET", re.compile(r"^/eth/v1/beacon/light_client/finality_update$"), "lc_finality"),
@@ -528,7 +985,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
 
 # Routes that mutate chain state and therefore serialize on the chain's
 # mutation lock. Everything else reads immutable snapshots.
-_MUTATING = {"publish_block", "publish_atts", "publish_sync", "publish_contributions", "publish_aggregates"}
+_MUTATING = {"publish_block", "publish_blinded", "publish_atts", "publish_sync", "publish_contributions", "publish_aggregates"}
 
 
 def _make_handler(api: BeaconApiServer):
@@ -548,6 +1005,21 @@ def _make_handler(api: BeaconApiServer):
             n = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(n) if n else b"{}"
             return json.loads(raw.decode() or "{}")
+
+        def _block_body(self):
+            """Block publication body: JSON envelope, or raw SSZ when
+            Content-Type is application/octet-stream with the fork named by
+            Eth-Consensus-Version (the Beacon API's SSZ request flow)."""
+            ctype = self.headers.get("Content-Type", "")
+            if "octet-stream" in ctype:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                version = self.headers.get("Eth-Consensus-Version")
+                body = {"data": "0x" + raw.hex()}
+                if version:
+                    body["version"] = version.lower()
+                return body
+            return self._body()
 
         def _stream_events(self, topics) -> None:
             """SSE stream (events.rs + eventsource): holds the connection
@@ -595,6 +1067,9 @@ def _make_handler(api: BeaconApiServer):
                         ]
                         self._stream_events(topics)
                         return
+                    if name == "health":
+                        self._reply(api.node_health_code(), {})
+                        return
                     if name in _MUTATING:
                         # Only mutation routes serialize on the chain lock;
                         # reads work from the atomically-swapped head snapshot
@@ -605,7 +1080,8 @@ def _make_handler(api: BeaconApiServer):
                             out = self._route(name, match, q)
                     else:
                         out = self._route(name, match, q)
-                    self._reply(200, {"data": out} if name != "produce_block" else out)
+                    enveloped = name not in ("produce_block", "produce_blinded")
+                    self._reply(200, {"data": out} if enveloped else out)
                     return
                 self._reply(404, {"message": f"no route {u.path}"})
             except ApiError as e:
@@ -621,7 +1097,7 @@ def _make_handler(api: BeaconApiServer):
             if name == "finality":
                 return api.get_finality_checkpoints(match.group(1))
             if name == "validators":
-                return api.get_validators(match.group(1))
+                return api.get_validators(match.group(1), q.get("id"))
             if name == "syncing":
                 return api.get_syncing()
             if name == "version":
@@ -645,11 +1121,57 @@ def _make_handler(api: BeaconApiServer):
                     _unhex(q["graffiti"]) if "graffiti" in q else b"",
                 )
             if name == "publish_block":
-                return api.publish_block(self._body())
+                return api.publish_block(self._block_body())
+            if name == "publish_blinded":
+                return api.publish_blinded_block(self._block_body())
+            if name == "produce_blinded":
+                return api.produce_blinded_block(
+                    int(match.group(1)),
+                    _unhex(q["randao_reveal"]),
+                    _unhex(q["graffiti"]) if "graffiti" in q else b"",
+                )
             if name == "publish_atts":
                 return api.publish_attestations(self._body())
             if name == "header":
-                return api.get_header()
+                return api.get_header(match.group(1))
+            if name == "block_root":
+                return api.get_block_root(match.group(1))
+            if name == "validator":
+                return api.get_validator(match.group(1), match.group(2))
+            if name == "validator_balances":
+                return api.get_validator_balances(match.group(1), q.get("id"))
+            if name == "committees":
+                return api.get_committees(match.group(1), q)
+            if name == "randao":
+                return api.get_randao(match.group(1), q)
+            if name == "blob_sidecars":
+                return api.get_blob_sidecars(match.group(1), q)
+            if name == "identity":
+                return api.get_node_identity()
+            if name == "peers":
+                return api.get_node_peers()
+            if name == "config_spec":
+                return api.get_config_spec()
+            if name == "fork_schedule":
+                return api.get_fork_schedule()
+            if name == "deposit_contract":
+                return api.get_deposit_contract()
+            if name == "pool_att_slashings":
+                return api.get_pool_attester_slashings()
+            if name == "post_att_slashing":
+                return api.post_pool_attester_slashing(self._body())
+            if name == "pool_prop_slashings":
+                return api.get_pool_proposer_slashings()
+            if name == "post_prop_slashing":
+                return api.post_pool_proposer_slashing(self._body())
+            if name == "pool_exits":
+                return api.get_pool_voluntary_exits()
+            if name == "post_exit":
+                return api.post_pool_voluntary_exit(self._body())
+            if name == "pool_bls_changes":
+                return api.get_pool_bls_changes()
+            if name == "post_bls_change":
+                return api.post_pool_bls_change(self._body())
             if name == "lc_bootstrap":
                 b = api.chain.light_client_cache.bootstrap(
                     _unhex(match.group(1))

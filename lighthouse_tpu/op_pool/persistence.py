@@ -49,6 +49,9 @@ def serialize_pool(pool) -> bytes:
                 type(e).encode(e).hex()
                 for e in pool._voluntary_exits.values()
             ],
+            "bls_changes": [
+                type(c).encode(c).hex() for c in pool._bls_changes.values()
+            ],
         }
     return json.dumps(doc).encode()
 
@@ -84,4 +87,9 @@ def restore_pool(pool, ns, blob: bytes) -> int:
         for h in doc.get("voluntary_exits", []):
             e = ns.SignedVoluntaryExit.decode(bytes.fromhex(h))
             pool._voluntary_exits[int(e.message.validator_index)] = e
+        for h in doc.get("bls_changes", []):
+            from ..types.containers import SignedBLSToExecutionChange
+
+            c = SignedBLSToExecutionChange.decode(bytes.fromhex(h))
+            pool._bls_changes[int(c.message.validator_index)] = c
     return n

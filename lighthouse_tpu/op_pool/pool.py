@@ -30,6 +30,7 @@ class OperationPool:
         self._attester_slashings: list = []
         self._proposer_slashings: dict[int, object] = {}
         self._voluntary_exits: dict[int, object] = {}
+        self._bls_changes: dict[int, object] = {}
         # The reference wraps each map in its own RwLock (lib.rs:48-60);
         # here one pool lock serializes inserts (HTTP publishers) against
         # packing reads (block production).
@@ -130,6 +131,26 @@ class OperationPool:
         idx = int(exit_msg.message.validator_index)
         with self._lock:
             self._voluntary_exits.setdefault(idx, exit_msg)
+
+    def insert_bls_to_execution_change(self, signed_change) -> None:
+        idx = int(signed_change.message.validator_index)
+        with self._lock:
+            self._bls_changes.setdefault(idx, signed_change)
+
+    def get_bls_to_execution_changes(self, state) -> list:
+        """Changes still applicable (validator still has a BLS credential),
+        bounded by MAX_BLS_TO_EXECUTION_CHANGES
+        (lib.rs get_bls_to_execution_changes)."""
+        with self._lock:
+            items = list(self._bls_changes.items())
+        out = [
+            c
+            for i, c in items
+            if i < len(state.validators)
+            and bytes(state.validators[i].withdrawal_credentials)[:1] == b"\x00"
+        ]
+        limit = getattr(self.spec.preset, "MAX_BLS_TO_EXECUTION_CHANGES", 16)
+        return out[:limit]
 
     def get_slashings_and_exits(self, state):
         from ..types.helpers import is_slashable_validator

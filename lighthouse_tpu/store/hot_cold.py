@@ -74,6 +74,32 @@ class HotColdDB:
     def delete_block(self, block_root: bytes) -> None:
         self.hot.delete(DBColumn.BeaconBlock, block_root)
 
+    # -- blob sidecars (the separate blobs DB of the reference store) -----------
+
+    def put_blob_sidecars(self, block_root: bytes, sidecar_ssz: list) -> None:
+        """Length-prefixed concatenation of the block's sidecar encodings
+        (hot_cold_store.rs put_blobs; blobs live beside blocks, pruned by the
+        same finalization migrator)."""
+        out = b"".join(
+            len(s).to_bytes(4, "little") + s for s in sidecar_ssz
+        )
+        self.hot.put(DBColumn.BeaconBlobs, block_root, out)
+
+    def get_blob_sidecars(self, block_root: bytes) -> list | None:
+        raw = self.hot.get(DBColumn.BeaconBlobs, block_root)
+        if raw is None:
+            return None
+        out, off = [], 0
+        while off < len(raw):
+            n = int.from_bytes(raw[off : off + 4], "little")
+            off += 4
+            out.append(raw[off : off + n])
+            off += n
+        return out
+
+    def delete_blob_sidecars(self, block_root: bytes) -> None:
+        self.hot.delete(DBColumn.BeaconBlobs, block_root)
+
     # -- hot states -------------------------------------------------------------
 
     def put_state(self, state_root: bytes, state_ssz: bytes, slot: int) -> None:

@@ -1,0 +1,285 @@
+"""Beacon API breadth: committees/balances/randao/headers/pool/node/config
+endpoints, SSZ request bodies, and the blinded-block flow.
+
+Refs: /root/reference/beacon_node/http_api/src/lib.rs (the full endpoint
+inventory), publish_blocks.rs (blinded publication), validator/mod.rs
+(status taxonomy).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import BeaconChain
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.op_pool import OperationPool
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def api():
+    spec = minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0
+    )
+    h = StateHarness(spec, 16)
+    h.extend_chain(3)
+    clock = ManualSlotClock(h.state.slot)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock)
+    chain.execution_layer = h.el
+    # give the chain a real head block (anchor states hold no block body)
+    clock.set_slot(h.state.slot + 1)
+    sb = h.produce_block(h.state.slot + 1)
+    h.apply_block(sb)
+    chain.process_block(sb)
+    pool = OperationPool(spec, chain.ns.Attestation)
+    server = BeaconApiServer(chain, op_pool=pool).start()
+    yield h, chain, clock, server, pool
+    server.stop()
+
+
+def _get(server, path, expect=200):
+    try:
+        with urllib.request.urlopen(server.url + path) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, (path, e.code, e.read().decode()[:200])
+        return e.code, None
+
+
+def _post(server, path, body, headers=None):
+    data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(
+        server.url + path,
+        data=data,
+        headers=headers or {"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_committees_and_balances(api):
+    h, chain, _, server, _ = api
+    _, res = _get(server, "/eth/v1/beacon/states/head/committees")
+    committees = res["data"]
+    assert committees and all(c["validators"] for c in committees)
+    # filters narrow the listing
+    slot = committees[0]["slot"]
+    _, res = _get(
+        server, f"/eth/v1/beacon/states/head/committees?slot={slot}"
+    )
+    assert all(c["slot"] == slot for c in res["data"])
+
+    _, res = _get(server, "/eth/v1/beacon/states/head/validator_balances")
+    assert len(res["data"]) == 16
+    _, res = _get(
+        server, "/eth/v1/beacon/states/head/validator_balances?id=3,5"
+    )
+    assert [e["index"] for e in res["data"]] == ["3", "5"]
+
+
+def test_single_validator_and_status(api):
+    h, chain, _, server, _ = api
+    _, res = _get(server, "/eth/v1/beacon/states/head/validators/2")
+    v = res["data"]
+    assert v["index"] == "2"
+    assert v["status"] == "active_ongoing"
+    assert v["validator"]["effective_balance"] == str(
+        chain.spec.max_effective_balance
+    )
+    pk = v["validator"]["pubkey"]
+    _, by_pk = _get(server, f"/eth/v1/beacon/states/head/validators/{pk}")
+    assert by_pk["data"]["index"] == "2"
+    _get(server, "/eth/v1/beacon/states/head/validators/99", expect=404)
+
+
+def test_randao_headers_and_block_root(api):
+    h, chain, _, server, _ = api
+    _, res = _get(server, "/eth/v1/beacon/states/head/randao")
+    assert res["data"]["randao"].startswith("0x")
+
+    _, hdr = _get(server, "/eth/v1/beacon/headers/head")
+    msg = hdr["data"]["header"]["message"]
+    assert int(msg["slot"]) == chain.head.slot
+    _, root = _get(server, "/eth/v1/beacon/blocks/head/root")
+    assert root["data"]["root"] == hdr["data"]["root"]
+    # by-slot resolution agrees with the canonical walk
+    _, at_slot = _get(server, f"/eth/v1/beacon/headers/{msg['slot']}")
+    assert at_slot["data"]["root"] == hdr["data"]["root"]
+
+
+def test_node_and_config_endpoints(api):
+    _, chain, _, server, _ = api
+    code, _ = _get(server, "/eth/v1/node/health")
+    assert code in (200, 206)
+    _, ident = _get(server, "/eth/v1/node/identity")
+    assert "peer_id" in ident["data"]
+    _, peers = _get(server, "/eth/v1/node/peers")
+    assert peers["data"] == []
+    _, spec_doc = _get(server, "/eth/v1/config/spec")
+    assert spec_doc["data"]["PRESET_BASE"] == "minimal"
+    assert spec_doc["data"]["CAPELLA_FORK_EPOCH"] == "0"
+    _, sched = _get(server, "/eth/v1/config/fork_schedule")
+    assert len(sched["data"]) == 6
+    _, dc = _get(server, "/eth/v1/config/deposit_contract")
+    assert "address" in dc["data"]
+
+
+def test_pool_proposer_slashing_roundtrip(api):
+    h, chain, _, server, pool = api
+    from lighthouse_tpu.types.containers import (
+        BeaconBlockHeader,
+        ProposerSlashing,
+        SignedBeaconBlockHeader,
+    )
+    from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+    st = chain.head.state
+    slot = int(st.slot)
+    dom = get_domain(
+        chain.spec, st, chain.spec.DOMAIN_BEACON_PROPOSER,
+        epoch=chain.spec.compute_epoch_at_slot(slot),
+    )
+    hdrs = []
+    for body_root in (b"\x0a" * 32, b"\x0b" * 32):
+        header = BeaconBlockHeader(
+            slot=slot, proposer_index=0, parent_root=b"\x01" * 32,
+            state_root=b"\x02" * 32, body_root=body_root,
+        )
+        hdrs.append(
+            SignedBeaconBlockHeader(
+                message=header,
+                signature=h._sign(0, compute_signing_root(header, dom)),
+            )
+        )
+    sl = ProposerSlashing(signed_header_1=hdrs[0], signed_header_2=hdrs[1])
+    _post(
+        server,
+        "/eth/v1/beacon/pool/proposer_slashings",
+        {"data": "0x" + ProposerSlashing.encode(sl).hex()},
+    )
+    _, res = _get(server, "/eth/v1/beacon/pool/proposer_slashings")
+    assert len(res["data"]) == 1
+    # pooled evidence rides the next produced block
+    state = chain.head.state
+    proposer_sl, _, _ = pool.get_slashings_and_exits(state)
+    assert len(proposer_sl) == 1
+    # invalid (identical headers) is rejected with 400
+    bad = ProposerSlashing(signed_header_1=hdrs[0], signed_header_2=hdrs[0])
+    with pytest.raises(urllib.error.HTTPError):
+        _post(
+            server,
+            "/eth/v1/beacon/pool/proposer_slashings",
+            {"data": "0x" + ProposerSlashing.encode(bad).hex()},
+        )
+
+
+def test_pool_bls_change_roundtrip(api):
+    h, chain, _, server, pool = api
+    from lighthouse_tpu.types.containers import (
+        BLSToExecutionChange,
+        SignedBLSToExecutionChange,
+    )
+    from lighthouse_tpu.types.helpers import compute_domain, compute_signing_root
+
+    st = chain.head.state
+    change = BLSToExecutionChange(
+        validator_index=7,
+        from_bls_pubkey=bytes(st.validators[7].pubkey),
+        to_execution_address=b"\x77" * 20,
+    )
+    domain = compute_domain(
+        chain.spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        chain.spec.genesis_fork_version,
+        bytes(st.genesis_validators_root),
+    )
+    signed = SignedBLSToExecutionChange(
+        message=change,
+        signature=h._sign(7, compute_signing_root(change, domain)),
+    )
+    _post(
+        server,
+        "/eth/v1/beacon/pool/bls_to_execution_changes",
+        {"data": "0x" + SignedBLSToExecutionChange.encode(signed).hex()},
+    )
+    _, res = _get(server, "/eth/v1/beacon/pool/bls_to_execution_changes")
+    assert len(res["data"]) == 1
+    assert pool.get_bls_to_execution_changes(chain.head.state)
+
+
+def test_blinded_production_and_publication(api):
+    h, chain, clock, server, _ = api
+    from lighthouse_tpu.state_transition import (
+        get_beacon_proposer_index,
+        process_slots,
+    )
+    from lighthouse_tpu.types.blinded import blinded_types
+    from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+    slot = chain.head.slot + 1
+    clock.set_slot(slot)
+    state = chain.head.state.copy()
+    if state.slot < slot:
+        process_slots(chain.spec, state, slot)
+    proposer = get_beacon_proposer_index(chain.spec, state)
+    epoch = chain.spec.compute_epoch_at_slot(slot)
+    reveal = h.randao_reveal(state, proposer, epoch)
+    _, res = _get(
+        server,
+        f"/eth/v1/validator/blinded_blocks/{slot}?randao_reveal=0x{reveal.hex()}",
+    )
+    fork = res["version"]
+    ns = blinded_types(chain.ns)
+    inner_cls = dict(ns.blinded_block_types[fork].FIELDS)["message"]
+    inner = inner_cls.decode(bytes.fromhex(res["data"][2:]))
+    assert inner.body.execution_payload_header.block_number >= 1
+
+    dom = get_domain(
+        chain.spec, state, chain.spec.DOMAIN_BEACON_PROPOSER, epoch=epoch
+    )
+    sig = h._sign(int(proposer), compute_signing_root(inner, dom))
+    signed = ns.blinded_block_types[fork](message=inner, signature=sig)
+    _post(
+        server,
+        "/eth/v1/beacon/blinded_blocks",
+        {
+            "version": fork,
+            "data": "0x" + type(signed).encode(signed).hex(),
+        },
+    )
+    assert chain.head.slot == slot  # unblinded block imported
+    # keep the harness chain in step with the chain-produced block
+    h.apply_block(chain._blocks[chain.head.root])
+
+
+def test_ssz_request_body_publication(api):
+    h, chain, clock, server, _ = api
+    slot = chain.head.slot + 1
+    clock.set_slot(slot)
+    signed = h.produce_block(slot)
+    h.apply_block(signed)  # keep the harness chain in step
+    fork = chain.spec.fork_name_at_slot(slot)
+    raw = type(signed).encode(signed)
+    code, _ = _post(
+        server,
+        "/eth/v1/beacon/blocks",
+        raw,
+        headers={
+            "Content-Type": "application/octet-stream",
+            "Eth-Consensus-Version": fork,
+        },
+    )
+    assert code == 200
+    assert chain.head.slot == slot

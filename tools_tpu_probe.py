@@ -1,0 +1,75 @@
+"""Stepwise TPU compile probe: times compile + run of each verification
+kernel shape, smallest first, so a pathological compile is isolated to a
+shape instead of wedging the whole bench. Writes one line per step."""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    # the persistent compile cache comes from lighthouse_tpu's package init
+    print(f"platform: {jax.devices()[0].platform}", flush=True)
+    from lighthouse_tpu.bls import tpu_backend as tb
+    from lighthouse_tpu.ops.bls import fq
+
+    steps = [
+        ("mont_mul[64]", lambda: jax.jit(fq.mont_mul).lower(
+            jax.ShapeDtypeStruct((64, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((64, 25), jnp.uint64),
+        )),
+        ("verify[4]", lambda: tb._verify_kernel(4).lower(
+            jax.ShapeDtypeStruct((4, 3, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((4, 6, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((4, 2, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((4, 2, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((4,), jnp.uint64),
+            jax.ShapeDtypeStruct((4,), jnp.bool_),
+        )),
+        ("gathered[8,16]", lambda: tb._gathered_kernel(8, 16).lower(
+            jax.ShapeDtypeStruct((1024, 3, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((8, 16), jnp.int32),
+            jax.ShapeDtypeStruct((8, 16), jnp.bool_),
+            jax.ShapeDtypeStruct((8, 2, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((8, 2, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((8, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((8, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((8,), jnp.uint64),
+            jax.ShapeDtypeStruct((8,), jnp.bool_),
+            jax.ShapeDtypeStruct((8,), jnp.uint64),
+            jax.ShapeDtypeStruct((8,), jnp.bool_),
+        )),
+        ("gathered[64,512]", lambda: tb._gathered_kernel(64, 512).lower(
+            jax.ShapeDtypeStruct((16384, 3, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((64, 512), jnp.int32),
+            jax.ShapeDtypeStruct((64, 512), jnp.bool_),
+            jax.ShapeDtypeStruct((64, 2, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((64, 2, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((64, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((64, 25), jnp.uint64),
+            jax.ShapeDtypeStruct((64,), jnp.uint64),
+            jax.ShapeDtypeStruct((64,), jnp.bool_),
+            jax.ShapeDtypeStruct((64,), jnp.uint64),
+            jax.ShapeDtypeStruct((64,), jnp.bool_),
+        )),
+    ]
+    for name, mk in steps:
+        t0 = time.perf_counter()
+        lowered = mk()
+        t_lower = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        t_compile = time.perf_counter() - t0
+        print(
+            f"{name}: lower {t_lower:.1f}s compile {t_compile:.1f}s",
+            flush=True,
+        )
+    print("probe done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
