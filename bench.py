@@ -281,28 +281,15 @@ def _kernel_flops(cache, items) -> float:
     try:
         n_pad = tb.bucket(len(items))
         k_pad = tb.bucket(max(len(ix) for ix, _, _ in items))
-        kern = tb._gathered_kernel(n_pad, k_pad)
-        # trace with abstract twins of the real call's operands
-        import jax
-
-        u_shape = jax.ShapeDtypeStruct((n_pad, 2, 25), jnp.uint64)
-        args = (
-            jax.ShapeDtypeStruct(cache.shape, jnp.uint64),
-            jax.ShapeDtypeStruct((n_pad, k_pad), jnp.int32),
-            jax.ShapeDtypeStruct((n_pad, k_pad), jnp.bool_),
-            u_shape,
-            u_shape,
-            jax.ShapeDtypeStruct((n_pad, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((n_pad, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((n_pad,), jnp.uint64),
-            jax.ShapeDtypeStruct((n_pad,), jnp.bool_),
-            jax.ShapeDtypeStruct((n_pad,), jnp.uint64),
-            jax.ShapeDtypeStruct((n_pad,), jnp.bool_),
-        )
-        cost = kern.lower(*args).compile().cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0]
-        return float(cost.get("flops", 0.0))
+        total = 0.0
+        for _name, lowered in tb.stage_lowerings(
+            n_pad, k_pad, int(cache.shape[0])
+        ):
+            cost = lowered.compile().cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0]
+            total += float(cost.get("flops", 0.0))
+        return total
     except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
         print(f"# cost_analysis unavailable: {e}", file=sys.stderr)
         return 0.0

@@ -67,52 +67,49 @@ def _set_prologue(pk_agg, sig, scalars, valid):
     return set_ok, pk_scaled, sig_sum
 
 
-def _pairing_epilogue(pk_scaled, sig_acc, mx, my, set_ok, valid):
-    """Shared tail of every verification kernel: affine conversion, append the
-    e(-g1, sig_acc) pair, one multi-pairing with a single final exponentiation,
-    and the combined verdict (pairing & all per-set checks & non-empty)."""
-    pkx, pky = g1.to_affine(pk_scaled)
-    sax, say = g2.to_affine(sig_acc)
-    px = jnp.concatenate([pkx[:, 0, :], _MG1_X[None]], axis=0)
-    py = jnp.concatenate([pky[:, 0, :], _MG1_Y[None]], axis=0)
-    qx = jnp.concatenate([mx, sax[None]], axis=0)
-    qy = jnp.concatenate([my, say[None]], axis=0)
-    pair_valid = jnp.concatenate([valid, jnp.ones((1,), dtype=bool)])
-    ok = pairing.multi_pairing_is_one(px, py, qx, qy, pair_valid)
-    return ok & jnp.all(set_ok) & jnp.any(valid)
-
-
 @functools.lru_cache(maxsize=None)
+def _prologue_stage(n_pad: int):
+    """Security prologue as its own compile unit: subgroup checks, random
+    scaling, masked signature sum, then affine conversion."""
+
+    @jax.jit
+    def run(pk_agg, sig, scalars, valid):
+        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
+        pkx, pky = g1.to_affine(pk_scaled)
+        sax, say = g2.to_affine(sig_acc)
+        return pkx, pky, sax, say, set_ok
+
+    return run
+
+
 def _verify_kernel(n_pad: int):
-    """Batch verification over n_pad sets (padded entries masked by `valid`).
+    """Batch verification over n_pad sets (padded entries masked by `valid`)
+    as two device stages (prologue, pairing) — intermediates stay on device;
+    the stages compile and cache independently (see _gathered_kernel).
 
     Inputs: pk_agg [n,3,25] (G1 projective), sig [n,6,25] (G2 projective),
     msg affine (mx, my) [n,2,25] each, scalars [n] uint64, valid [n] bool.
     Returns scalar bool: the whole batch verifies.
     """
+    pro = _prologue_stage(n_pad)
+    pair = _pair_stage(n_pad)
 
-    @jax.jit
     def verify(pk_agg, sig, mx, my, scalars, valid):
-        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
-        return _pairing_epilogue(pk_scaled, sig_acc, mx, my, set_ok, valid)
+        pkx, pky, sax, say, set_ok = pro(pk_agg, sig, scalars, valid)
+        return pair(pkx, pky, sax, say, mx, my, set_ok, valid)
 
     return verify
 
 
-@functools.lru_cache(maxsize=None)
 def _verify_kernel_h2c(n_pad: int):
-    """_verify_kernel with device h2c fused in: takes hash_to_field residues
-    (u0, u1) instead of pre-hashed message points, so the SSWU/isogeny/
-    cofactor chain compiles into the same program instead of dispatching
-    eagerly op by op."""
-    from ..ops.bls import h2c
+    """_verify_kernel with the device h2c stage in front: takes hash_to_field
+    residues (u0, u1) instead of pre-hashed message points."""
+    h2c_k = _h2c_stage(n_pad)
+    ver = _verify_kernel(n_pad)
 
-    @jax.jit
     def verify(pk_agg, sig, u0, u1, scalars, valid):
-        mg2 = h2c.map_to_g2(u0, u1)
-        mx, my = g2.to_affine(mg2)
-        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
-        return _pairing_epilogue(pk_scaled, sig_acc, mx, my, set_ok, valid)
+        mx, my = h2c_k(u0, u1)
+        return ver(pk_agg, sig, mx, my, scalars, valid)
 
     return verify
 
@@ -146,9 +143,70 @@ def aggregate_pubkeys_device(pts: list, k_pad: int | None = None):
 
 
 @functools.lru_cache(maxsize=None)
+def _h2c_stage(n_pad: int):
+    """Stage 1 of the chain hot path: device SSWU + isogeny + cofactor
+    clearing + affine conversion for the message points. Shape depends only
+    on n_pad — one compile is shared across every keys-per-set bucket."""
+    from ..ops.bls import h2c
+
+    @jax.jit
+    def run(u0, u1):
+        return g2.to_affine(h2c.map_to_g2(u0, u1))
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _prep_stage(n_pad: int, k_pad: int):
+    """Stage 2: signature decompression + cache gather + masked aggregation +
+    the security prologue (subgroup checks, random scaling, signature sum),
+    ending in affine coordinates for the pairing stage."""
+    from ..ops.bls import curve
+    from .serde import raw_to_mont
+
+    @jax.jit
+    def run(cache, idx, mask, sxc0, sxc1, s_flag, sig_wf, scalars, valid):
+        x_mont = raw_to_mont(jnp.stack([sxc0, sxc1], axis=-2))
+        sig, on_curve = g2.decompress(x_mont, s_flag)
+        pts = cache[idx]                                 # [n, k, 3, 25]
+        pk_agg = curve.point_sum(
+            1, jnp.moveaxis(pts, 1, 0), jnp.moveaxis(mask, 1, 0)
+        )
+        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
+        set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(mask, axis=1)))
+        pkx, pky = g1.to_affine(pk_scaled)
+        sax, say = g2.to_affine(sig_acc)
+        return pkx, pky, sax, say, set_ok
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _pair_stage(n_pad: int):
+    """Stage 3: batched Miller loops + ONE final exponentiation + verdict."""
+
+    @jax.jit
+    def run(pkx, pky, sax, say, mxa, mya, set_ok, valid):
+        px = jnp.concatenate([pkx[:, 0, :], _MG1_X[None]], axis=0)
+        py = jnp.concatenate([pky[:, 0, :], _MG1_Y[None]], axis=0)
+        qx = jnp.concatenate([mxa, sax[None]], axis=0)
+        qy = jnp.concatenate([mya, say[None]], axis=0)
+        pair_valid = jnp.concatenate([valid, jnp.ones((1,), dtype=bool)])
+        ok = pairing.multi_pairing_is_one(px, py, qx, qy, pair_valid)
+        return ok & jnp.all(set_ok) & jnp.any(valid)
+
+    return run
+
+
 def _gathered_kernel(n_pad: int, k_pad: int):
-    """The fully-fused chain hot path: cache-gather + aggregate + device h2c +
-    device signature decompression + RLC batch verification, one jit.
+    """The chain hot path: cache-gather + aggregate + device h2c + device
+    signature decompression + RLC batch verification, as THREE separately
+    jitted device stages (intermediates never leave the device).
+
+    Staged, not fused: one fused program compiled superlinearly (the r3
+    pathology — 461 s at toy shape, >50 min at 64x512 on the TPU server);
+    the stages compile independently, persist separately in the compilation
+    cache, and the h2c stage's shape does not depend on k_pad at all.
 
     Inputs:
       cache  [N, 3, 25]  device-resident decompressed pubkeys (projective)
@@ -160,29 +218,61 @@ def _gathered_kernel(n_pad: int, k_pad: int):
       scalars [n] uint64  RLC scalars; valid [n] bool    real (non-pad) sets
 
     Zero per-batch host point conversion: the only H2D traffic is indices,
-    96-byte signature limbs, and hash_to_field residues.
+    96-byte signature limbs, and hash_to_field residues. Reference semantics:
+    blst verify_multiple_aggregate_signatures (crypto/bls/src/impls/blst.rs:37-119).
     """
-    from ..ops.bls import curve, h2c
-    from .serde import raw_to_mont
+    h2c_k = _h2c_stage(n_pad)
+    prep_k = _prep_stage(n_pad, k_pad)
+    pair_k = _pair_stage(n_pad)
 
-    @jax.jit
     def run(cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf, scalars, valid):
-        # messages: device SSWU + isogeny + cofactor clearing
-        mg2 = h2c.map_to_g2(u0, u1)                      # [n, 6, 25] projective
-        mxa, mya = g2.to_affine(mg2)
-        # signatures: device decompression (sqrt + sign select)
-        x_mont = raw_to_mont(jnp.stack([sxc0, sxc1], axis=-2))
-        sig, on_curve = g2.decompress(x_mont, s_flag)
-        # pubkeys: gather + masked tree-sum aggregation
-        pts = cache[idx]                                 # [n, k, 3, 25]
-        pk_agg = curve.point_sum(
-            1, jnp.moveaxis(pts, 1, 0), jnp.moveaxis(mask, 1, 0)
+        mxa, mya = h2c_k(u0, u1)
+        pkx, pky, sax, say, set_ok = prep_k(
+            cache, idx, mask, sxc0, sxc1, s_flag, sig_wf, scalars, valid
         )
-        set_ok, pk_scaled, sig_acc = _set_prologue(pk_agg, sig, scalars, valid)
-        set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(mask, axis=1)))
-        return _pairing_epilogue(pk_scaled, sig_acc, mxa, mya, set_ok, valid)
+        return pair_k(pkx, pky, sax, say, mxa, mya, set_ok, valid)
 
     return run
+
+
+def stage_lowerings(n_pad: int, k_pad: int, n_validators: int = 1024):
+    """(name, jax Lowered) for each device stage of the gathered chain-hot-path
+    kernel at the given shapes — shared by the compile probes and the bench's
+    cost analysis (the staged design means there is no single fused program
+    to introspect)."""
+    u64 = jnp.uint64
+    sd = jax.ShapeDtypeStruct
+    u = sd((n_pad, 2, 25), u64)
+    return [
+        ("h2c", _h2c_stage(n_pad).lower(u, u)),
+        (
+            "prep",
+            _prep_stage(n_pad, k_pad).lower(
+                sd((n_validators, 3, 25), u64),
+                sd((n_pad, k_pad), jnp.int32),
+                sd((n_pad, k_pad), jnp.bool_),
+                sd((n_pad, 25), u64),
+                sd((n_pad, 25), u64),
+                sd((n_pad,), u64),
+                sd((n_pad,), jnp.bool_),
+                sd((n_pad,), u64),
+                sd((n_pad,), jnp.bool_),
+            ),
+        ),
+        (
+            "pair",
+            _pair_stage(n_pad).lower(
+                sd((n_pad, 1, 25), u64),
+                sd((n_pad, 1, 25), u64),
+                sd((2, 25), u64),
+                sd((2, 25), u64),
+                u,
+                u,
+                sd((n_pad,), jnp.bool_),
+                sd((n_pad,), jnp.bool_),
+            ),
+        ),
+    ]
 
 
 def verify_indexed_sets_device(cache_arr, items) -> bool:

@@ -82,10 +82,11 @@ def map_to_curve_sswu(u):
     gx1 = g_of(x1)
     x2 = tower.fq2_mul(zu2, x1)
     gx2 = g_of(x2)
-    y1, is_sq = tower.fq2_sqrt(gx1)
-    y2, _ok2 = tower.fq2_sqrt(gx2)
+    # one stacked sqrt for both candidates (halves the compiled chain)
+    y12, ok12 = tower.fq2_sqrt(jnp.stack([gx1, gx2], axis=0))
+    is_sq = ok12[0]
     x = tower.t_select(is_sq, x1, x2)
-    y = tower.t_select(is_sq, y1, y2)
+    y = tower.t_select(is_sq, y12[0], y12[1])
     flip = tower.fq2_sgn0(u) != tower.fq2_sgn0(y)
     y = plans.carry_norm(tower.t_select(flip, tower.fq2_neg(tower.t_canon(y)), y))
     return x, y
@@ -130,11 +131,13 @@ def _mul_by_abs_x(p):
 def clear_cofactor(p):
     """[x^2-x-1]P + [x-1]psi(P) + psi^2(2P) with x < 0:
     = [x]([x]P) - [x]P - P + [x]psi(P) - psi(P) + psi^2(2P)
-    where [x]Q = -[|x|]Q."""
+    where [x]Q = -[|x|]Q. psi commutes with scalar multiplication
+    ([x]psi(P) = psi([x]P)), so only TWO |x|-chains are needed (they are
+    sequentially dependent: x^2 needs xP)."""
     xP = curve.point_neg(2, _mul_by_abs_x(p))          # [x]P
     xxP = curve.point_neg(2, _mul_by_abs_x(xP))        # [x^2]P
     psiP = g2.psi(p)
-    xpsiP = curve.point_neg(2, _mul_by_abs_x(psiP))    # [x]psi(P)
+    xpsiP = g2.psi(xP)                                 # [x]psi(P) = psi([x]P)
     psi2_2P = g2.psi(g2.psi(curve.point_dbl(2, p)))
     acc = curve.point_add(2, xxP, curve.point_neg(2, xP))
     acc = curve.point_add(2, acc, curve.point_neg(2, p))
@@ -147,10 +150,12 @@ def clear_cofactor(p):
 
 
 def map_to_g2(u0, u1):
-    """Device map: two field elements per message -> projective G2 point."""
-    q0 = iso_map(*map_to_curve_sswu(u0))
-    q1 = iso_map(*map_to_curve_sswu(u1))
-    return clear_cofactor(curve.point_add(2, q0, q1))
+    """Device map: two field elements per message -> projective G2 point.
+    u0/u1 are stacked into one doubled leading batch so SSWU + the isogeny
+    compile (and dispatch) ONCE instead of twice."""
+    u = jnp.stack([u0, u1], axis=0)
+    q = iso_map(*map_to_curve_sswu(u))
+    return clear_cofactor(curve.point_add(2, q[0], q[1]))
 
 
 def hash_to_curve_g2(msgs: list[bytes], dst: bytes):

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import fq
@@ -46,8 +47,9 @@ from ..bls_oracle.fields import P
 # --------------------------------------------------------------------------------------
 
 PUB_VALUE_P = 16          # public elements have value < 16 p
-PUB_LIMB = (1 << 16) - 1  # ... and 16-bit limbs (limbs 0..23)
-PUB_TOP_LIMB = 2          # ... limb 24 <= 2 (guaranteed by carry_norm's double fold)
+PUB_LIMB = (1 << 17) - 1  # ... and 17-bit limbs (limbs 0..23); exact 16-bit
+                          # normalization happens only at comparison sites
+PUB_TOP_LIMB = 2          # ... limb 24 <= 2 (value < 16p refines it)
 
 MAX_VALUE_P = 1200        # lazy operand budget (must match fq._IN_VALUE)
 MAX_LIMB = 1 << 22
@@ -269,7 +271,75 @@ def sub_bound(minuend: "_Bound", subtrahend: "_Bound") -> "_Bound":
 
 
 PUB_BOUND = _Bound(PUB_VALUE_P, PUB_LIMB, PUB_TOP_LIMB)
-CANON_BOUND = _Bound(1, PUB_LIMB, 0)
+CANON_BOUND = _Bound(1, (1 << 16) - 1, 0)  # canonical values are exact 16-bit
+
+
+def _lincomb_bounds(rows: list[LC], bound_for, name: str):
+    """Static bound walk of a lincomb: per-row (value_p, limb, top) plus the
+    per-row borrow constant covering its negative part. Returns
+    (neg_consts [n_rows, 25] uint64, worst _Bound)."""
+    consts = np.zeros((len(rows), fq.NLIMBS), dtype=np.uint64)
+    worst = _Bound(0, 0, 0)
+    for r, lc in enumerate(rows):
+        value_p = limb = top = 0
+        n_limb = n_top = 0
+        any_neg = False
+        for idx, c in sorted(lc.d.items()):
+            b = bound_for(idx)
+            mag = abs(c)
+            if c > 0:
+                value_p += mag * b.value_p
+                limb += mag * b.limb
+                top += mag * b.top
+            else:
+                any_neg = True
+                n_limb += mag * b.limb
+                n_top += mag * b.top
+        if any_neg:
+            subc, K = _subc(n_limb, n_top)
+            consts[r] = subc
+            value_p += K
+            limb += int(max(subc[:24]))
+            top += int(subc[24])
+        assert value_p < MAX_VALUE_P, f"{name}: value bound {value_p}p exceeds budget"
+        assert limb < MAX_LIMB, f"{name}: limb bound {limb} exceeds 2^22"
+        worst.value_p = max(worst.value_p, value_p)
+        worst.limb = max(worst.limb, limb)
+        worst.top = max(worst.top, top)
+    return consts, worst
+
+
+def _lincomb_matrices(rows: list[LC], n_in: int):
+    """Split the integer row matrix into positive / negative-magnitude halves
+    (M_pos - M_neg). uint64 so the dot stays in the limb dtype."""
+    m_pos = np.zeros((len(rows), n_in), dtype=np.uint64)
+    m_neg = np.zeros((len(rows), n_in), dtype=np.uint64)
+    for r, lc in enumerate(rows):
+        for idx, c in lc.d.items():
+            if c > 0:
+                m_pos[r, idx] = c
+            else:
+                m_neg[r, idx] = -c
+    return m_pos, m_neg
+
+
+def _apply_matrices(m_pos, m_neg, consts, x):
+    """rows @ x as two constant-matrix dot_generals plus the borrow constants:
+    out[..., r, :] = (M_pos @ x) + (C_r - M_neg @ x). The dot form emits ~5 HLO
+    ops per lincomb where the term-by-term form emitted hundreds (slice +
+    scale + add per coefficient) — program size was the r3 compile bottleneck."""
+    dn = (((1,), (x.ndim - 2,)), ((), ()))
+    pos = jax.lax.dot_general(
+        jnp.asarray(m_pos), x, dn, preferred_element_type=jnp.uint64
+    )
+    pos = jnp.moveaxis(pos, 0, -2)
+    if not m_neg.any():
+        return pos
+    neg = jax.lax.dot_general(
+        jnp.asarray(m_neg), x, dn, preferred_element_type=jnp.uint64
+    )
+    neg = jnp.moveaxis(neg, 0, -2)
+    return pos + (jnp.asarray(consts) - neg)
 
 
 def lincomb(rows: list[LC], x, in_bound: _Bound, name: str = "", bound_for=None) -> tuple:
@@ -277,44 +347,9 @@ def lincomb(rows: list[LC], x, in_bound: _Bound, name: str = "", bound_for=None)
     (stacked [..., L, 25], out_bound). ``bound_for(idx)`` optionally gives a
     per-index input bound (default: in_bound for all indices)."""
     bound_for = bound_for or (lambda _i: in_bound)
-    outs = []
-    worst = _Bound(0, 0, 0)
-    for lc in rows:
-        pos = None
-        neg = None
-        value_p = limb = top = 0
-        n_limb = n_top = 0  # accumulated per-limb bounds of the negative part
-        for idx, c in sorted(lc.d.items()):
-            b = bound_for(idx)
-            mag = abs(c)
-            term = x[..., idx, :]
-            if mag != 1:
-                term = term * np.uint64(mag)
-            if c > 0:
-                pos = term if pos is None else pos + term
-                value_p += mag * b.value_p
-                limb += mag * b.limb
-                top += mag * b.top
-            else:
-                neg = term if neg is None else neg + term
-                n_limb += mag * b.limb
-                n_top += mag * b.top
-        if neg is not None:
-            subc, K = _subc(n_limb, n_top)
-            base = jnp.asarray(subc) - neg
-            pos = base if pos is None else pos + base
-            value_p += K
-            limb += int(max(subc[:24]))
-            top += int(subc[24])
-        elif pos is None:
-            pos = jnp.zeros_like(x[..., 0, :])
-        assert value_p < MAX_VALUE_P, f"{name}: value bound {value_p}p exceeds budget"
-        assert limb < MAX_LIMB, f"{name}: limb bound {limb} exceeds 2^22"
-        outs.append(pos)
-        worst.value_p = max(worst.value_p, value_p)
-        worst.limb = max(worst.limb, limb)
-        worst.top = max(worst.top, top)
-    return jnp.stack(outs, axis=-2), worst
+    consts, worst = _lincomb_bounds(rows, bound_for, name)
+    m_pos, m_neg = _lincomb_matrices(rows, x.shape[-2])
+    return _apply_matrices(m_pos, m_neg, consts, x), worst
 
 
 # Raw (non-domain) limbs of 2^384 mod p: folds limb-24 excess back below 2^384.
@@ -322,18 +357,53 @@ def lincomb(rows: list[LC], x, in_bound: _Bound, name: str = "", bound_for=None)
 _RT384 = jnp.asarray(fq.int_to_limbs((1 << 384) % P))
 
 
+def _verify_carry_norm_schedule(n_folds: int) -> None:
+    """Import-time proof that the carry_norm schedule lands on PUB_BOUND for
+    ANY input within the lazy budget (limbs < 2^22, value < 1200p): walk the
+    per-limb/value bounds through each round+fold with exact integers."""
+    limbs = [MAX_LIMB - 1] * fq.NLIMBS
+    value = MAX_VALUE_P * P
+    rt = [int(v) for v in fq._RT384_NP]
+    rt_val = fq._RT384_VAL
+    for _ in range(n_folds):
+        # carry-save round (width-preserving; value invariant)
+        carried = [0] + [b >> 16 for b in limbs[:-1]]
+        limbs = [min(b, 0xFFFF) + c for b, c in zip(limbs, carried)]
+        limbs = [min(b, value >> (16 * i)) for i, b in enumerate(limbs)]
+        # fold the 2^384 excess: new value <= (value below 2^384) + top * rt_val
+        top = limbs[24]
+        assert top * max(rt) + max(limbs[:24]) < 1 << 64
+        lo_val = sum(b << (16 * i) for i, b in enumerate(limbs[:24]))
+        value = min(lo_val, value) + top * rt_val
+        limbs = [b + top * rt[i] for i, b in enumerate(limbs[:24])] + [
+            top * rt[24]
+        ]
+        limbs = [min(b, value >> (16 * i)) for i, b in enumerate(limbs)]
+    # final round
+    carried = [0] + [b >> 16 for b in limbs[:-1]]
+    limbs = [min(b, 0xFFFF) + c for b, c in zip(limbs, carried)]
+    limbs = [min(b, value >> (16 * i)) for i, b in enumerate(limbs)]
+    assert value < PUB_VALUE_P * P, f"carry_norm value bound {value / P}p"
+    assert max(limbs) <= PUB_LIMB, f"carry_norm limb bound {max(limbs):#x}"
+    assert limbs[24] <= PUB_TOP_LIMB
+
+
+_CARRY_NORM_FOLDS = 3
+_verify_carry_norm_schedule(_CARRY_NORM_FOLDS)
+
+
 def carry_norm(x):
-    """Restore public bounds: normalize limbs, then fold the 2^384-and-up excess
-    through (2^384 mod p), twice. Bound walk for input value V*p (V < 600):
-    after fold 1 the value is < 2^384 + top*(2^384 mod p) with top <= V/9.33+1,
-    i.e. < (9.34 + 0.33*(V*0.108+1))p < 62p; its top limb is <= 9, so fold 2
-    lands < (9.34 + 0.33*10)p < 13p with limb24 <= 2. Hence the public contract
-    PUB_VALUE_P=16 / PUB_TOP_LIMB=2 holds for any input under the budget."""
-    for _ in range(2):
-        x = fq._carry_propagate(x, fq.NLIMBS)
+    """Restore public bounds (value < 16p, 17-bit limbs, top limb <= 2) for any
+    input within the lazy budget: alternate width-preserving carry-save rounds
+    with folds of the 2^384-and-up excess through (2^384 mod p). The schedule
+    is proved at import time by _verify_carry_norm_schedule — and it is fully
+    elementwise (~25 HLO ops), where the previous exact-walk version cost
+    three lax.scans per call site."""
+    for _ in range(_CARRY_NORM_FOLDS):
+        x = fq._carry_rounds(x, 1)
         top = x[..., 24]
         x = x * fq._MASK_NO24 + top[..., None] * _RT384
-    return fq._carry_propagate(x, fq.NLIMBS)
+    return fq._carry_rounds(x, 1)
 
 
 _SUBC_WIDE_CACHE: dict[tuple[int, int], np.ndarray] = {}
@@ -390,34 +460,27 @@ def execute(plan: Plan, a, b, in_bound_a=PUB_BOUND, in_bound_b=PUB_BOUND, name="
         ]
     else:
         out_rows = plan.out_rows
-    outs = []
     worst_limb = 0
-    for lc in out_rows:
-        pos = None
-        neg = None
+    consts = np.zeros((len(out_rows), n_wide), dtype=np.uint64)
+    for r, lc in enumerate(out_rows):
         limb = n_limb = 0
+        any_neg = False
         for idx, c in sorted(lc.d.items()):
             lb = lane_limb if idx < L else in_bound_a.limb
             mag = abs(c)
-            term = T[..., idx, :]
-            if mag != 1:
-                term = term * np.uint64(mag)
             if c > 0:
-                pos = term if pos is None else pos + term
                 limb += mag * lb
             else:
-                neg = term if neg is None else neg + term
+                any_neg = True
                 n_limb += mag * lb
-        if neg is not None:
+        if any_neg:
             subc = _subc_wide(n_wide, n_limb)
-            pos = (jnp.asarray(subc) - neg) + (0 if pos is None else pos)
+            consts[r] = subc
             limb += int(subc.max())
-        elif pos is None:
-            pos = jnp.zeros_like(T[..., 0, :])
         assert limb < 1 << 63, f"{name}: wide accumulator bound 2^{limb.bit_length()}"
         worst_limb = max(worst_limb, limb)
-        outs.append(pos)
-    out = jnp.stack(outs, axis=-2)
+    m_pos, m_neg = _lincomb_matrices(out_rows, T.shape[-2])
+    out = _apply_matrices(m_pos, m_neg, consts, T)
     value_bound = sum(worst_limb << (16 * i) for i in range(n_wide))
     return fq.reduce_limbs(out, [worst_limb] * n_wide, value_bound)
 

@@ -60,9 +60,11 @@ def t_canon(a):
     return fq.normalize(a)
 
 
-def t_eq(a, b):
-    """Equality on *canonicalized* elements."""
-    return jnp.all(t_canon(a) == t_canon(b), axis=(-2, -1))
+def t_eq(a, b, b_bound: _Bound = PUB_BOUND):
+    """Equality mod p via ONE canonicalization of the lazy difference (a == b
+    iff canonical(a - b) == 0) — half the program size of canonicalizing both
+    sides."""
+    return jnp.all(fq.canonical(t_sub(a, b, b_bound)) == 0, axis=(-2, -1))
 
 
 def t_is_zero(a):
@@ -178,24 +180,12 @@ def fq2_inv(a):
 
 
 def fq2_pow_fixed(a, e: int):
-    nbits = max(e.bit_length(), 1)
-    bits = jnp.asarray(
-        [(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=jnp.uint64
-    )
-    # varying-safe initial carry (see fq.pow_fixed_scan)
-    o = one(2, a.shape[:-2]) + a * jnp.uint64(0)
-
-    def step(res, bit):
-        res = fq2_sqr(res)
-        res = t_select(bit == 1, fq2_mul(res, a), res)
-        return res, None
-
-    res, _ = jax.lax.scan(step, o, bits)
-    return res
+    """a^e for a fixed exponent (windowed table scan; see fq.windowed_pow)."""
+    return fq.windowed_pow(a, e, fq2_sqr, fq2_mul, one(2))
 
 
 def fq2_sgn0(a):
-    c = fq.from_mont(t_canon(a))
+    c = fq.from_mont(a)  # one canonicalization (from_mont fully reduces)
     c0, c1 = c[..., 0, :], c[..., 1, :]
     s0 = c0[..., 0] & jnp.uint64(1)
     z0 = fq.is_zero(c0)
@@ -334,32 +324,27 @@ def fq12_cyclotomic_sqr(a, in_bound=PUB_BOUND):
     return plans.execute(plans.CYC_SQR, a, a, in_bound, in_bound, "cyc_sqr")
 
 
-def _repeat_cyc_sqr(a, n: int):
-    if n <= 0:
-        return a
-    if n <= 4:
-        for _ in range(n):
-            a = fq12_cyclotomic_sqr(a)
-        return a
-    return jax.lax.fori_loop(0, n, lambda _, g: fq12_cyclotomic_sqr(g), a)
-
-
 def fq12_cyclotomic_exp_abs_x(a):
     """a^|x| (|x| = 0xd201000000010000, popcount 6): the exponent is fixed at
     trace time, so zero bits are squarings only — 63 cyc_sqr + 5 fq12_mul
     instead of the ladder's 63 x (cyc_sqr + mul + select). Final
-    exponentiation calls this 5 times; it is the hard part's hot loop."""
-    bits = bin(-_of.BLS_X)[2:]
-    res = a
-    i = 1
-    while i < len(bits):
-        j = bits.find("1", i)
-        if j == -1:
-            res = _repeat_cyc_sqr(res, len(bits) - i)
-            break
-        res = _repeat_cyc_sqr(res, j - i + 1)
-        res = fq12_mul(res, a)
-        i = j + 1
+    exponentiation calls this 5 times; the segment schedule runs as one
+    lax.scan (dynamic-count cyc-sqr fori_loop + masked multiply) so each call
+    site compiles a single (sqr + mul) body instead of unrolling the chain."""
+    from .curve import fixed_schedule
+
+    segs = fixed_schedule(-_of.BLS_X)
+    runs = jnp.asarray([r for r, _ in segs], dtype=jnp.int32)
+    muls = jnp.asarray([m for _, m in segs], dtype=jnp.int32)
+
+    def seg_body(res, seg):
+        run, mulf = seg
+        res = jax.lax.fori_loop(
+            0, run, lambda _, g: fq12_cyclotomic_sqr(g), res
+        )
+        return t_select(mulf == 1, fq12_mul(res, a), res), None
+
+    res, _ = jax.lax.scan(seg_body, a, (runs, muls))
     return res
 
 

@@ -1,0 +1,121 @@
+"""Per-stage compile-time probe for the BLS verification chain.
+
+Times jax trace (.lower()) and XLA compile (.compile()) separately for each
+stage of the fused kernel at a given (sets, keys) shape, plus HLO op/while
+counts — the instrument for the round-4 compile-time attack (VERDICT r3 #1).
+
+Usage: python tools_compile_probe.py [n_sets] [k_keys] [stage ...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import devcpu  # noqa: F401  (CPU platform before jax init)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _hlo_stats(lowered):
+    txt = lowered.as_text()
+    n_lines = txt.count("\n")
+    n_while = txt.count("stablehlo.while")
+    return n_lines, n_while
+
+
+def probe(name, fn, *args):
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(*args)
+    t_trace = time.perf_counter() - t0
+    n_lines, n_while = _hlo_stats(lowered)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+    print(
+        f"{name:28s} trace {t_trace:7.2f}s  compile {t_compile:7.2f}s  "
+        f"hlo_lines {n_lines:7d}  while_ops {n_while:4d}",
+        flush=True,
+    )
+    return compiled
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    only = set(sys.argv[3:])
+
+    from lighthouse_tpu.ops.bls import curve, g1, g2, h2c, pairing
+    from lighthouse_tpu.bls import tpu_backend as tb
+    from lighthouse_tpu.bls.serde import raw_to_mont
+
+    u = jnp.ones((n, 2, 25), dtype=jnp.uint64)
+    sig6 = jnp.ones((n, 6, 25), dtype=jnp.uint64)
+    pk3 = jnp.ones((n, 3, 25), dtype=jnp.uint64)
+    cache = jnp.ones((1024, 3, 25), dtype=jnp.uint64)
+    idx = jnp.zeros((n, k), dtype=jnp.int32)
+    mask = jnp.ones((n, k), dtype=bool)
+    scalars = jnp.ones((n,), dtype=jnp.uint64)
+    valid = jnp.ones((n,), dtype=bool)
+    x25 = jnp.ones((n, 25), dtype=jnp.uint64)
+    f12 = jnp.ones((n + 1, 12, 25), dtype=jnp.uint64)
+
+    def want(s):
+        return not only or s in only
+
+    if want("h2c"):
+        probe("h2c.map_to_g2", h2c.map_to_g2, u, u)
+    if want("decompress"):
+        probe(
+            "g2.decompress",
+            lambda c0, c1, s: g2.decompress(
+                raw_to_mont(jnp.stack([c0, c1], axis=-2)), s
+            ),
+            x25, x25, scalars,
+        )
+    if want("gather"):
+        probe(
+            "gather+point_sum",
+            lambda c, i, m: curve.point_sum(
+                1, jnp.moveaxis(c[i], 1, 0), jnp.moveaxis(m, 1, 0)
+            ),
+            cache, idx, mask,
+        )
+    if want("prologue"):
+        probe("_set_prologue", tb._set_prologue, pk3, sig6, scalars, valid)
+    if want("subgroup"):
+        probe("g2.subgroup_check", g2.subgroup_check, sig6)
+    if want("scale64"):
+        probe("g1.scale_u64", lambda p, s: g1.scale_u64(p, s), pk3, scalars)
+    if want("miller"):
+        probe(
+            "miller_loop",
+            pairing.miller_loop,
+            jnp.ones((n + 1, 25), dtype=jnp.uint64),
+            jnp.ones((n + 1, 25), dtype=jnp.uint64),
+            jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
+            jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
+        )
+    if want("finalexp"):
+        probe(
+            "fq12_prod+final_exp",
+            lambda f: pairing.final_exponentiation(pairing.fq12_prod(f)),
+            f12,
+        )
+    if want("fused"):
+        for st_name, lowered in tb.stage_lowerings(n, k, 1024):
+            t0 = time.perf_counter()
+            txt = lowered.as_text()
+            lowered.compile()
+            t_compile = time.perf_counter() - t0
+            print(
+                f"stage {st_name:22s} compile {t_compile:7.2f}s  "
+                f"hlo_lines {txt.count(chr(10)):7d}",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -22,39 +22,11 @@ def main():
             jax.ShapeDtypeStruct((64, 25), jnp.uint64),
             jax.ShapeDtypeStruct((64, 25), jnp.uint64),
         )),
-        ("verify[4]", lambda: tb._verify_kernel(4).lower(
+        ("prologue[4]", lambda: tb._prologue_stage(4).lower(
             jax.ShapeDtypeStruct((4, 3, 25), jnp.uint64),
             jax.ShapeDtypeStruct((4, 6, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((4, 2, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((4, 2, 25), jnp.uint64),
             jax.ShapeDtypeStruct((4,), jnp.uint64),
             jax.ShapeDtypeStruct((4,), jnp.bool_),
-        )),
-        ("gathered[8,16]", lambda: tb._gathered_kernel(8, 16).lower(
-            jax.ShapeDtypeStruct((1024, 3, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((8, 16), jnp.int32),
-            jax.ShapeDtypeStruct((8, 16), jnp.bool_),
-            jax.ShapeDtypeStruct((8, 2, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((8, 2, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((8, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((8, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((8,), jnp.uint64),
-            jax.ShapeDtypeStruct((8,), jnp.bool_),
-            jax.ShapeDtypeStruct((8,), jnp.uint64),
-            jax.ShapeDtypeStruct((8,), jnp.bool_),
-        )),
-        ("gathered[64,512]", lambda: tb._gathered_kernel(64, 512).lower(
-            jax.ShapeDtypeStruct((16384, 3, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((64, 512), jnp.int32),
-            jax.ShapeDtypeStruct((64, 512), jnp.bool_),
-            jax.ShapeDtypeStruct((64, 2, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((64, 2, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((64, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((64, 25), jnp.uint64),
-            jax.ShapeDtypeStruct((64,), jnp.uint64),
-            jax.ShapeDtypeStruct((64,), jnp.bool_),
-            jax.ShapeDtypeStruct((64,), jnp.uint64),
-            jax.ShapeDtypeStruct((64,), jnp.bool_),
         )),
     ]
     for name, mk in steps:
@@ -68,6 +40,25 @@ def main():
             f"{name}: lower {t_lower:.1f}s compile {t_compile:.1f}s",
             flush=True,
         )
+    # staged chain-hot-path shapes: time each stage's lower+compile separately
+    # (stage_lowerings traces all three up front; lower time is reported as
+    # one line so nothing is misattributed per stage)
+    for n_pad, k_pad, n_val in [(8, 16, 1024), (64, 512, 16384)]:
+        t0 = time.perf_counter()
+        lowerings = tb.stage_lowerings(n_pad, k_pad, n_val)
+        print(
+            f"lower all 3 stages[{n_pad},{k_pad}]: "
+            f"{time.perf_counter() - t0:.1f}s",
+            flush=True,
+        )
+        for st_name, lowered in lowerings:
+            t0 = time.perf_counter()
+            lowered.compile()
+            print(
+                f"{st_name}[{n_pad},{k_pad}]: compile "
+                f"{time.perf_counter() - t0:.1f}s",
+                flush=True,
+            )
     print("probe done", flush=True)
 
 
