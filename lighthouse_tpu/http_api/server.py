@@ -57,6 +57,10 @@ class BeaconApiServer:
 
         self._payload_cache: "OrderedDict[bytes, object]" = OrderedDict()
         self._payload_cache_size = 8
+        # insert+evict / pop interleave across ThreadingHTTPServer handler
+        # threads; the GIL makes single dict ops atomic but not the
+        # size-trim loop, so guard the cache with its own small lock
+        self._payload_cache_lock = threading.Lock()
         # Share the CHAIN's mutation lock so handler threads serialize
         # against every other driver of this chain (network router,
         # simulator loops), not just each other.
@@ -193,6 +197,22 @@ class BeaconApiServer:
             raise ApiError(404, f"validator index {i} out of range")
         return i
 
+    def _resolve_validator_indices(self, st, ids: str) -> list[int]:
+        """Batch-query id resolution: unknown pubkeys / out-of-range indices
+        are OMITTED (the reference filters by set membership — VCs routinely
+        query keys whose deposits are not yet processed); malformed ids are
+        still a 400. 404 is reserved for the single-validator endpoint."""
+        out = []
+        for x in ids.split(","):
+            if not x:
+                continue
+            try:
+                out.append(self._resolve_validator_index(st, x))
+            except ApiError as e:
+                if e.code != 404:
+                    raise
+        return out
+
     def get_validators(self, state_id: str, ids: str | None = None):
         from ..types.spec import FAR_FUTURE_EPOCH
 
@@ -200,11 +220,7 @@ class BeaconApiServer:
         spec = self.chain.spec
         epoch = int(st.slot) // spec.preset.SLOTS_PER_EPOCH
         if ids:
-            indices = [
-                self._resolve_validator_index(st, x)
-                for x in ids.split(",")
-                if x
-            ]
+            indices = self._resolve_validator_indices(st, ids)
         else:
             indices = range(len(st.validators))
         return [
@@ -224,11 +240,7 @@ class BeaconApiServer:
     def get_validator_balances(self, state_id: str, ids: str | None = None):
         st = self._state(state_id)
         if ids:
-            indices = [
-                self._resolve_validator_index(st, x)
-                for x in ids.split(",")
-                if x
-            ]
+            indices = self._resolve_validator_indices(st, ids)
         else:
             indices = range(len(st.validators))
         return [
@@ -241,9 +253,20 @@ class BeaconApiServer:
         filters (http_api committees endpoint)."""
         st = self._state(state_id)
         spec = self.chain.spec
-        epoch = int(
-            q.get("epoch", int(st.slot) // spec.preset.SLOTS_PER_EPOCH)
-        )
+        state_epoch = int(st.slot) // spec.preset.SLOTS_PER_EPOCH
+        epoch = int(q.get("epoch", state_epoch))
+        # the state can answer exactly [previous, current, next] epochs
+        # (shuffling seeds beyond the lookahead are not yet decided; older
+        # epochs would silently compute WRONG committees) — match the
+        # reference's bounds with a 400, and never process_slots over an
+        # unbounded attacker-chosen range
+        if epoch > state_epoch + 1 or epoch + 1 < state_epoch:
+            raise ApiError(
+                400,
+                f"epoch {epoch} outside the computable range "
+                f"[{max(state_epoch - 1, 0)}, {state_epoch + 1}] "
+                f"of state {state_id}",
+            )
         state = st
         start = spec.start_slot(epoch)
         if state.slot < start:
@@ -514,9 +537,10 @@ class BeaconApiServer:
         inner_cls = dict(chain.ns.block_types[fork].FIELDS)["message"]
         block = inner_cls.decode(_unhex(full["data"]))
         payload = block.body.execution_payload
-        self._payload_cache[bytes(payload.block_hash)] = payload
-        while len(self._payload_cache) > self._payload_cache_size:
-            self._payload_cache.popitem(last=False)
+        with self._payload_cache_lock:
+            self._payload_cache[bytes(payload.block_hash)] = payload
+            while len(self._payload_cache) > self._payload_cache_size:
+                self._payload_cache.popitem(last=False)
         signed_shell = chain.ns.block_types[fork](
             message=block, signature=b"\x00" * 96
         )
@@ -543,7 +567,8 @@ class BeaconApiServer:
             _unhex(body["data"])
         )
         hdr = signed_blinded.message.body.execution_payload_header
-        payload = self._payload_cache.pop(bytes(hdr.block_hash), None)
+        with self._payload_cache_lock:
+            payload = self._payload_cache.pop(bytes(hdr.block_hash), None)
         if payload is None:
             raise ApiError(400, "unknown payload for blinded block")
         try:
@@ -681,7 +706,18 @@ class BeaconApiServer:
         return {"version": fork, "data": _hex(cls.encode(sb))}
 
     def get_block_root(self, block_id: str):
-        return {"root": _hex(self._block_root_of(block_id))}
+        root = self._block_root_of(block_id)
+        if block_id.startswith("0x") and self._signed_block(root) is None:
+            raise ApiError(404, f"block {block_id[:18]}… not held")
+        return {"root": _hex(root)}
+
+    def _is_canonical(self, root: bytes, slot: int) -> bool:
+        """True iff `root` is the canonical block at its slot (explicit
+        0x-root lookups may name blocks off the canonical chain)."""
+        try:
+            return self._block_root_of(str(int(slot))) == root
+        except ApiError:
+            return False
 
     def get_header(self, block_id: str = "head"):
         root = self._block_root_of(block_id)
@@ -713,9 +749,14 @@ class BeaconApiServer:
                 "body_root": _hex(hdr.body_root),
             }
             sig = _hex(b"\x00" * 96)
+        canonical = (
+            True
+            if not block_id.startswith("0x")
+            else self._is_canonical(root, int(fields["slot"]))
+        )
         return {
             "root": _hex(root),
-            "canonical": True,
+            "canonical": canonical,
             "header": {"message": fields, "signature": sig},
         }
 

@@ -464,6 +464,10 @@ def canonical(a):
     """Fully reduce to the canonical residue < p (comparisons, parity,
     serialization). Accepts anything within the lazy budget."""
     t = reduce_limbs(a, [_IN_LIMB] * a.shape[-1], _IN_VALUE)
+    # reduce_limbs leaves 17-bit limbs (PUB_LIMB_TARGET); the 2^381 folds
+    # below mask limbs to 16 bits (_MASK_LOW381), so an EXACT propagation
+    # must come first or bit 16 of limbs 0..22 is silently dropped
+    t = _carry_propagate(t, NLIMBS)
     # value < 13p: two sub-limb folds at the 2^381 boundary bring it under 2p
     for _ in range(2):
         hi = (t[..., 23] >> np.uint64(13)) + (t[..., 24] << np.uint64(3))

@@ -90,6 +90,75 @@ def test_committees_and_balances(api):
     assert [e["index"] for e in res["data"]] == ["3", "5"]
 
 
+def test_batch_queries_skip_unknown_ids(api):
+    """Batch validator queries OMIT unresolvable ids instead of failing the
+    whole request (the reference filters by set membership — a VC querying a
+    pending-deposit pubkey must still get statuses for the rest); malformed
+    ids stay 400 and the single-validator endpoint stays 404."""
+    h, chain, _, server, _ = api
+    unknown_pk = "0x" + "ab" * 48
+    _, res = _get(
+        server,
+        f"/eth/v1/beacon/states/head/validators?id=3,{unknown_pk},5",
+    )
+    assert [e["index"] for e in res["data"]] == ["3", "5"]
+    _, res = _get(
+        server,
+        "/eth/v1/beacon/states/head/validator_balances?id=99,1",
+    )
+    assert [e["index"] for e in res["data"]] == ["1"]
+    _get(
+        server,
+        "/eth/v1/beacon/states/head/validators?id=not-an-id",
+        expect=400,
+    )
+
+
+def test_committees_epoch_bounds(api):
+    """Far-future epochs must 400 (no unbounded process_slots on a state
+    copy per request) and epochs before the state's computable window must
+    400 (their committees would be silently wrong)."""
+    h, chain, _, server, _ = api
+    state_epoch = chain.head.state.slot // chain.spec.preset.SLOTS_PER_EPOCH
+    _get(
+        server,
+        f"/eth/v1/beacon/states/head/committees?epoch={state_epoch + 2}",
+        expect=400,
+    )
+    _get(
+        server,
+        "/eth/v1/beacon/states/head/committees?epoch=1000000",
+        expect=400,
+    )
+    if state_epoch >= 2:
+        _get(
+            server,
+            f"/eth/v1/beacon/states/head/committees?epoch={state_epoch - 2}",
+            expect=400,
+        )
+    # next epoch (the lookahead) is fine
+    _, res = _get(
+        server,
+        f"/eth/v1/beacon/states/head/committees?epoch={state_epoch + 1}",
+    )
+    assert res["data"]
+
+
+def test_block_root_unknown_404_and_canonical_flag(api):
+    h, chain, _, server, _ = api
+    # unknown explicit root: 404, not an echo
+    _get(
+        server,
+        "/eth/v1/beacon/blocks/0x" + "77" * 32 + "/root",
+        expect=404,
+    )
+    # a held root reports honestly on the canonical flag
+    _, hdr = _get(server, "/eth/v1/beacon/headers/head")
+    root = hdr["data"]["root"]
+    _, by_root = _get(server, f"/eth/v1/beacon/headers/{root}")
+    assert by_root["data"]["canonical"] is True
+
+
 def test_single_validator_and_status(api):
     h, chain, _, server, _ = api
     _, res = _get(server, "/eth/v1/beacon/states/head/validators/2")

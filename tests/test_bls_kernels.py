@@ -52,6 +52,27 @@ class TestFq:
         assert fq.to_ints(out) == [pow(x, of.P - 2, of.P) for x in xs]
         assert fq.to_int(jax.jit(fq.inv)(fq.from_int(0)[None])[0]) == 0  # inv0
 
+    def test_canonical_on_lazy_budget_inputs(self):
+        """canonical() must be exact for ANY input within the lazy budget
+        (limbs < 2^22, value < 1200p) — regression for the 17-bit-limb /
+        _MASK_LOW381 interaction: reduce_limbs leaves 17-bit limbs and the
+        2^381 folds mask to 16 bits, so a missing exact propagation silently
+        dropped bit 16 of limbs 0..22 (~55% of wide lazy inputs)."""
+        import numpy as np
+
+        nprng = np.random.default_rng(0)
+        raw = nprng.integers(0, 1 << 22, size=(200, 25), dtype=np.uint64)
+        # keep the value budget (< 1200p ~ 2^391): cap the top two limbs,
+        # leaving limbs 0..22 wide (bit 16 set — where the bug bit)
+        raw[:, 23] &= 0xFFFF
+        raw[:, 24] &= 0x3F
+        vals = [fq.limbs_to_int(raw[i]) for i in range(raw.shape[0])]
+        assert all(v < 1200 * of.P for v in vals)
+        out = np.asarray(fq.canonical(jnp.asarray(raw)))
+        for i, v in enumerate(vals):
+            got = fq.limbs_to_int(out[i])
+            assert got == v % of.P, f"row {i}: {got} != {v % of.P}"
+
     def test_from_mont_and_sgn0(self):
         x = rint()
         assert fq.to_int(fq.from_mont(fq.from_int(x)[None])[0], mont=False) == x
