@@ -338,30 +338,36 @@ def verify_indexed_sets_device(cache_arr, items) -> bool:
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_gathered_kernel(mesh, n_pad: int, k_pad: int):
-    """Multi-chip twin of ``_gathered_kernel``: the FULL fused chain hot path
-    (cache gather + aggregate + device h2c + signature decompression + RLC
-    verification) data-parallel over the mesh's ``sets`` axis.
-
-    The pubkey cache is replicated (every chip holds the decompressed
-    validator registry — ``validator_pubkey_cache.rs`` parity; ~100 MB at 1M
-    validators, well within HBM); each device gathers and aggregates only its
-    n/n_dev sets, hashes its messages to G2, runs its Miller loops, and emits
-    a local pairing product + local signature partial sum. The cross-device
-    G2 MSM reduction and Fq12 product combine over ICI, then one replicated
-    final exponentiation closes the batch. Reference semantics:
-    ``crypto/bls/src/impls/blst.rs:37-119``.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def _sharded_h2c_stage(mesh, n_pad: int):
+    """Sharded twin of ``_h2c_stage``: SSWU/isogeny/cofactor/affine on each
+    device's local slice of the sets axis (purely local — no collectives)."""
     from jax import shard_map
+    from jax.sharding import PartitionSpec as P
 
     from ..ops.bls import h2c
+
+    def local(u0, u1):
+        return g2.to_affine(h2c.map_to_g2(u0, u1))
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P("sets"),) * 2,
+        out_specs=(P("sets"),) * 2,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_prep_stage(mesh, n_pad: int, k_pad: int):
+    """Sharded twin of ``_prep_stage``: pubkey cache REPLICATED (every chip
+    holds the decompressed validator registry — validator_pubkey_cache.rs
+    parity; ~100 MB at 1M validators, well within HBM); each device
+    decompresses, gathers, and aggregates only its n/n_dev sets and emits
+    per-device G2 signature partial sums + a per-device set_ok verdict."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
     from .serde import raw_to_mont
 
-    def local_stage(cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf,
-                    scalars, valid):
-        mg2 = h2c.map_to_g2(u0, u1)
-        mxa, mya = g2.to_affine(mg2)
+    def local(cache, idx, mask, sxc0, sxc1, s_flag, sig_wf, scalars, valid):
         x_mont = raw_to_mont(jnp.stack([sxc0, sxc1], axis=-2))
         sig, on_curve = g2.decompress(x_mont, s_flag)
         pts = cache[idx]
@@ -371,29 +377,60 @@ def _sharded_gathered_kernel(mesh, n_pad: int, k_pad: int):
         set_ok, pk_scaled, sig_part = _set_prologue(pk_agg, sig, scalars, valid)
         set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(mask, axis=1)))
         pkx, pky = g1.to_affine(pk_scaled)
+        return pkx, pky, sig_part[None], jnp.all(set_ok)[None]
+
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(),) + (P("sets"),) * 8,
+        out_specs=(P("sets"),) * 4,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_array_prologue_stage(mesh, n_pad: int):
+    """Sharded twin of ``_prologue_stage`` (pre-aggregated pk/sig arrays)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(pk_agg, sig, scalars, valid):
+        set_ok, pk_scaled, sig_part = _set_prologue(pk_agg, sig, scalars, valid)
+        pkx, pky = g1.to_affine(pk_scaled)
+        return pkx, pky, sig_part[None], jnp.all(set_ok)[None]
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P("sets"),) * 4,
+        out_specs=(P("sets"),) * 4,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_miller_stage(mesh, n_pad: int):
+    """Per-device Miller loops over the local sets plus the local Fq12
+    product — one [n_dev, 12, 25] partial per device."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(pkx, pky, mxa, mya, valid):
         fs = pairing.miller_loop(pkx[:, 0, :], pky[:, 0, :], mxa, mya)
         fs = tower.t_select(valid, fs, tower.one(12, fs.shape[:-2]))
-        return (
-            pairing.fq12_prod(fs)[None],
-            sig_part[None],
-            jnp.all(set_ok)[None],
-            jnp.any(valid)[None],
-        )
+        return pairing.fq12_prod(fs)[None], jnp.any(valid)[None]
 
-    sharded = shard_map(
-        local_stage,
-        mesh=mesh,
-        in_specs=(P(),) + (P("sets"),) * 10,
-        out_specs=(P("sets"),) * 4,
-    )
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P("sets"),) * 5,
+        out_specs=(P("sets"),) * 2,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_combine_stage(mesh):
+    """The cross-device epilogue: G2-MSM reduction of the per-device
+    signature partials + Fq12 product of the per-device pairing partials
+    (XLA inserts the collectives over the mesh from the sharded operands),
+    one final Miller loop against -g1, ONE replicated final exponentiation,
+    and the combined verdict."""
 
     @jax.jit
-    def verify(cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf,
-               scalars, valid):
-        partial_f, partial_sig, ok_parts, any_parts = sharded(
-            cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf,
-            scalars, valid
-        )
+    def combine(partial_f, partial_sig, ok_parts, any_parts):
         sig_acc = g2.psum(partial_sig)
         f_all = pairing.fq12_prod(partial_f)
         sx, sy = g2.to_affine(sig_acc)
@@ -401,6 +438,33 @@ def _sharded_gathered_kernel(mesh, n_pad: int, k_pad: int):
         f = tower.fq12_mul(f_all, f_last)
         ok = tower.fq12_is_one(pairing.final_exponentiation(f))
         return ok & jnp.all(ok_parts) & jnp.any(any_parts)
+
+    return combine
+
+
+def _sharded_gathered_kernel(mesh, n_pad: int, k_pad: int):
+    """Multi-chip twin of ``_gathered_kernel``: the chain hot path (cache
+    gather + aggregate + device h2c + signature decompression + RLC
+    verification) data-parallel over the mesh's ``sets`` axis, as FOUR
+    separately jitted shard_map stages (h2c / prep / miller / combine —
+    fused single programs compiled superlinearly, the r3 pathology; staged
+    programs compile independently and cache persistently). Cross-device
+    combines ride the mesh via XLA collectives in the combine stage.
+    Reference semantics: ``crypto/bls/src/impls/blst.rs:37-119``.
+    """
+    h2c_k = _sharded_h2c_stage(mesh, n_pad)
+    prep_k = _sharded_prep_stage(mesh, n_pad, k_pad)
+    miller_k = _sharded_miller_stage(mesh, n_pad)
+    combine_k = _sharded_combine_stage(mesh)
+
+    def verify(cache, idx, mask, u0, u1, sxc0, sxc1, s_flag, sig_wf,
+               scalars, valid):
+        mxa, mya = h2c_k(u0, u1)
+        pkx, pky, partial_sig, ok_parts = prep_k(
+            cache, idx, mask, sxc0, sxc1, s_flag, sig_wf, scalars, valid
+        )
+        partial_f, any_parts = miller_k(pkx, pky, mxa, mya, valid)
+        return combine_k(partial_f, partial_sig, ok_parts, any_parts)
 
     return verify
 
@@ -462,49 +526,20 @@ def verify_indexed_sets_sharded(cache_arr, items, mesh) -> bool:
     return bool(np.asarray(ok))
 
 
-@functools.lru_cache(maxsize=None)
-def _sharded_verify_kernel(mesh):
+def _sharded_verify_kernel(mesh, n_pad: int):
     """Multi-chip twin of ``_verify_kernel``: dp over signature sets on the
-    mesh's ``sets`` axis. Each device scales its pubkeys/signatures, runs its
-    Miller loops, and forms a local pairing product + local signature partial
-    sum; the cross-device combine (G2 sum + Fq12 product + one final
-    exponentiation) rides the mesh via XLA collectives on the sharded outputs.
-    Reference semantics: ``crypto/bls/src/impls/blst.rs:37-119``.
+    mesh's ``sets`` axis, as three staged shard_map jits (array prologue /
+    miller / combine) sharing the gathered path's stages. Reference
+    semantics: ``crypto/bls/src/impls/blst.rs:37-119``.
     """
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+    pro_k = _sharded_array_prologue_stage(mesh, n_pad)
+    miller_k = _sharded_miller_stage(mesh, n_pad)
+    combine_k = _sharded_combine_stage(mesh)
 
-    def local_stage(pk_agg, sig, mx, my, scalars, valid):
-        set_ok, pk_scaled, sig_part = _set_prologue(pk_agg, sig, scalars, valid)
-        pkx, pky = g1.to_affine(pk_scaled)
-        fs = pairing.miller_loop(pkx[:, 0, :], pky[:, 0, :], mx, my)
-        fs = tower.t_select(valid, fs, tower.one(12, fs.shape[:-2]))
-        return (
-            pairing.fq12_prod(fs)[None],
-            sig_part[None],
-            jnp.all(set_ok)[None],
-            jnp.any(valid)[None],
-        )
-
-    sharded = shard_map(
-        local_stage,
-        mesh=mesh,
-        in_specs=(P("sets"),) * 6,
-        out_specs=(P("sets"),) * 4,
-    )
-
-    @jax.jit
     def verify(pk_agg, sig, mx, my, scalars, valid):
-        partial_f, partial_sig, ok_parts, any_parts = sharded(
-            pk_agg, sig, mx, my, scalars, valid
-        )
-        sig_acc = g2.psum(partial_sig)
-        f_all = pairing.fq12_prod(partial_f)
-        sx, sy = g2.to_affine(sig_acc)
-        f_last = pairing.miller_loop(_MG1_X, _MG1_Y, sx, sy)
-        f = tower.fq12_mul(f_all, f_last)
-        ok = tower.fq12_is_one(pairing.final_exponentiation(f))
-        return ok & jnp.all(ok_parts) & jnp.any(any_parts)
+        pkx, pky, partial_sig, ok_parts = pro_k(pk_agg, sig, scalars, valid)
+        partial_f, any_parts = miller_k(pkx, pky, mx, my, valid)
+        return combine_k(partial_f, partial_sig, ok_parts, any_parts)
 
     return verify
 
@@ -537,7 +572,7 @@ def verify_signature_sets_sharded(
         [secrets.randbits(RAND_BITS) or 1 for _ in range(n_pad)], dtype=np.uint64
     )
     valid = np.arange(n_pad) < n_real
-    ok = _sharded_verify_kernel(mesh)(
+    ok = _sharded_verify_kernel(mesh, n_pad)(
         pk_agg, sig, msg_x, msg_y, jnp.asarray(scalars), jnp.asarray(valid)
     )
     return bool(np.asarray(ok))
