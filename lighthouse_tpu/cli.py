@@ -95,6 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated UDP boot-node addresses for peer discovery",
     )
     bn.add_argument(
+        "--boot-enrs", default="",
+        help="comma-separated hex ENRs for discv5-style discovery",
+    )
+    bn.add_argument(
         "--validator-monitor-auto", action="store_true",
         help="monitor every validator (validator_monitor.rs auto mode)",
     )
@@ -184,6 +188,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     boot.add_argument("--port", type=int, default=4242)
     boot.add_argument("--host", default="0.0.0.0")
+    boot.add_argument(
+        "--enr", action="store_true",
+        help="serve discv5-style ENR discovery (prints this node's ENR hex)",
+    )
+    boot.add_argument(
+        "--fork-digest", default="00000000",
+        help="hex fork digest the ENR advertises (--enr mode)",
+    )
 
     sub.add_parser("version", help="print version")
     return parser
@@ -205,6 +217,7 @@ def run_bn(args) -> "object":
         debug_level=args.debug_level,
         listen_port=args.listen_port,
         boot_nodes=args.boot_nodes,
+        boot_enrs=args.boot_enrs,
         validator_monitor_auto=args.validator_monitor_auto,
         validator_monitor_indices=tuple(
             int(x) for x in args.validator_monitor_indices.split(",") if x
@@ -360,11 +373,21 @@ def main(argv=None) -> int:
     if args.command == "boot-node":
         import time
 
-        from .network.boot_node import BootNode
         from .utils.logging import init_logging
 
         init_logging("info")
-        node = BootNode(host=args.host, port=args.port).start()
+        if args.enr:
+            from .network.discovery import DiscoveryService
+
+            node = DiscoveryService(
+                fork_digest=bytes.fromhex(args.fork_digest),
+                ip=args.host, udp_port=args.port,
+            ).start()
+            print(json.dumps({"enr": node.enr.encode().hex()}), flush=True)
+        else:
+            from .network.boot_node import BootNode
+
+            node = BootNode(host=args.host, port=args.port).start()
         try:
             while True:
                 time.sleep(1)

@@ -124,7 +124,8 @@ class GossipsubTransport(SocketTransport):
                  rpc_timeout: float = 10.0,
                  params: GossipsubParams | None = None,
                  topics: list[str] | None = None,
-                 run_heartbeat: bool = True):
+                 run_heartbeat: bool = True,
+                 peer_manager=None, discovery=None):
         self.params = params or GossipsubParams()
         self._gs_lock = threading.RLock()
         self._subs: set[str] = set()
@@ -150,7 +151,8 @@ class GossipsubTransport(SocketTransport):
                 v for k, v in vars(Topic).items() if not k.startswith("_")
             ]
         self._subs.update(topics)
-        super().__init__(spec, host=host, port=port, rpc_timeout=rpc_timeout)
+        super().__init__(spec, host=host, port=port, rpc_timeout=rpc_timeout,
+                         peer_manager=peer_manager, discovery=discovery)
         self._hb_thread = None
         if run_heartbeat:
             self._hb_thread = threading.Thread(

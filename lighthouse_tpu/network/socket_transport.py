@@ -45,6 +45,7 @@ SCORE_MALFORMED = -50.0     # undecodable frame / codec error
 SCORE_HANDLER_ERROR = -10.0  # message that made the service raise
 SCORE_DUPLICATE = -0.5       # redundant gossip (mesh noise)
 SCORE_DELIVERY = 1.0         # first delivery of a message
+SCORE_RATE_LIMITED = -20.0   # request refused by the rate limiter
 SCORE_BAN_THRESHOLD = -100.0
 SCORE_DECAY = 0.9            # per decay interval
 
@@ -73,10 +74,23 @@ class SocketTransport(Transport):
     same node code runs over loopback (tests) or real sockets."""
 
     def __init__(self, spec, host: str = "127.0.0.1", port: int = 0,
-                 rpc_timeout: float = 10.0):
+                 rpc_timeout: float = 10.0, peer_manager=None, discovery=None):
+        from .peer_manager import PeerManager
+
         self.codec = MessageCodec(spec)
         self.rpc_timeout = rpc_timeout
         self._service = None
+        # durable peer records + ban lifecycle (peer_manager/mod.rs parity):
+        # scores and bans survive the TCP connection, so reconnects by a
+        # banned peer are refused until the ban expires
+        self.peer_manager = peer_manager or PeerManager()
+        self.discovery = discovery
+        # per-(peer, method) token buckets (rpc/rate_limiter.rs): refused
+        # requests get an RPC error + a score penalty; sustained flooding
+        # crosses the ban threshold and drops the peer
+        from .rate_limiter import RateLimiter
+
+        self.rate_limiter = RateLimiter()
         self._peers: dict[str, _Peer] = {}  # canonical addr -> peer
         self._lock = threading.Lock()
         self._seen: OrderedDict[bytes, None] = OrderedDict()
@@ -89,6 +103,9 @@ class SocketTransport(Transport):
         self._listener.bind((host, port))
         self._listener.listen(64)
         self.local_addr = f"{host}:{self._listener.getsockname()[1]}"
+        if self.discovery is not None:
+            self.discovery.peer_manager = self.peer_manager
+            self.discovery.update_tcp_port(self._listener.getsockname()[1])
         self._stopped = False
         threading.Thread(
             target=self._accept_loop, daemon=True,
@@ -113,14 +130,22 @@ class SocketTransport(Transport):
         with self._lock:
             for p in self._peers.values():
                 p.score *= SCORE_DECAY
+        self.peer_manager.decay_scores()
 
     def report_peer(self, addr: str, delta: float) -> None:
         """Application-level score report (sync demotions etc. — the
         reference's PeerAction reporting into the peer manager)."""
         with self._lock:
             peer = self._peers.get(addr)
-        if peer is not None and peer.adjust_score(delta) <= SCORE_BAN_THRESHOLD:
+        if peer is not None and self._score(peer, delta):
             self._drop_peer(peer, "banned (reported)")
+
+    def _score(self, peer: _Peer, delta: float) -> bool:
+        """Adjust both the connection-local score and the durable peer-DB
+        record; True when the peer has crossed the ban threshold."""
+        peer.adjust_score(delta)
+        self.peer_manager.report(peer.addr, delta)
+        return self.peer_manager.is_banned(addr=peer.addr)
 
     def _gossip_body(self, topic: str, message) -> tuple[bytes, bytes]:
         """Encode a gossip message into (msg_id, wire body). The single
@@ -166,8 +191,11 @@ class SocketTransport(Transport):
     # -- dialing / discovery ----------------------------------------------
 
     def dial(self, addr: str) -> bool:
-        """Connect to ``host:port``; HELLO exchanges canonical addresses."""
+        """Connect to ``host:port``; HELLO exchanges canonical addresses.
+        Banned peers are refused (reconnect suppression)."""
         if addr == self.local_addr or addr in self._peers:
+            return False
+        if self.peer_manager.is_banned(addr=addr):
             return False
         host, port = addr.rsplit(":", 1)
         try:
@@ -186,6 +214,22 @@ class SocketTransport(Transport):
         from .boot_node import client_announce
 
         found = client_announce(boot_addr, self.local_addr)
+        if dial:
+            for addr in found:
+                self.dial(addr)
+        return found
+
+    def discover_enr(self, dial: bool = True) -> list[str]:
+        """Run an iterative discv5-style lookup on the attached
+        DiscoveryService and dial the discovered TCP listeners (banned
+        peers filtered by dial())."""
+        if self.discovery is None:
+            return []
+        self.discovery.lookup()
+        found = [
+            a for a in self.discovery.known_tcp_addrs()
+            if a != self.local_addr
+        ]
         if dial:
             for addr in found:
                 self.dial(addr)
@@ -210,6 +254,7 @@ class SocketTransport(Transport):
 
     def _add_peer(self, sock: socket.socket, addr: str) -> _Peer:
         peer = _Peer(sock, addr)
+        self.peer_manager.on_connect(addr)
         with self._lock:
             old = self._peers.get(addr)
             self._peers[addr] = peer
@@ -242,6 +287,13 @@ class SocketTransport(Transport):
         with self._lock:
             if self._peers.get(peer.addr) is peer:
                 del self._peers[peer.addr]
+        self.peer_manager.on_disconnect(peer.addr)
+        if self.discovery is not None and why.startswith("banned"):
+            # a banned peer's record leaves the routing table too, so
+            # lookups stop advertising it while the ban lasts
+            for enr in self.discovery.table.all_records():
+                if enr.tcp_addr == peer.addr:
+                    self.discovery.table.remove(enr.node_id)
         try:
             peer.sock.close()
         except OSError:
@@ -294,13 +346,13 @@ class SocketTransport(Transport):
                 try:
                     self._handle_frame(peer, kind, body)
                 except WireError as e:
-                    if peer.adjust_score(SCORE_MALFORMED) <= SCORE_BAN_THRESHOLD:
+                    if self._score(peer, SCORE_MALFORMED):
                         self._drop_peer(peer, f"banned (codec: {e})")
                         return
                     log.warn("Malformed frame", addr=peer.addr, error=str(e),
                              score=round(peer.score, 1))
                 except Exception as e:  # noqa: BLE001 — protocol boundary
-                    if peer.adjust_score(SCORE_HANDLER_ERROR) <= SCORE_BAN_THRESHOLD:
+                    if self._score(peer, SCORE_HANDLER_ERROR):
                         self._drop_peer(peer, f"banned (handler: {e})")
                         return
                     log.warn("Peer message failed", addr=peer.addr,
@@ -330,6 +382,12 @@ class SocketTransport(Transport):
                     stale.sock.close()
                 except OSError:
                     pass
+            # reconnect suppression: a banned peer announcing its canonical
+            # address through a fresh inbound connection is cut here
+            if self.peer_manager.is_banned(addr=canonical):
+                self._drop_peer(peer, "banned (reconnect refused)")
+                return
+            self.peer_manager.on_connect(canonical)
         elif kind == _GOSSIP:
             tn = body[0]
             topic = body[1 : 1 + tn].decode()
@@ -346,10 +404,20 @@ class SocketTransport(Transport):
                 self._service.on_gossip(topic, message, peer.addr)
             self.delivered += 1
         elif kind == _REQ:
+            from .rate_limiter import request_cost
+
             (rid,) = struct.unpack(">Q", body[:8])
             mn = body[8]
             method = body[9 : 9 + mn].decode()
             payload = self.codec.decode_request(method, body[9 + mn :])
+            cost = request_cost(method, payload)
+            if not self.rate_limiter.allow(peer.addr, method, cost):
+                peer.send_frame(
+                    _ERROR, struct.pack(">Q", rid) + b"rate limited"
+                )
+                if self._score(peer, SCORE_RATE_LIMITED):
+                    self._drop_peer(peer, "banned (rpc flood)")
+                return
             try:
                 out = self._service.on_rpc(method, payload, peer.addr)
                 resp = self.codec.encode_response(method, out)

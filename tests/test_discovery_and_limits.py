@@ -1,0 +1,285 @@
+"""discv5-style discovery (signed ENRs, iterative FINDNODE, transitive
+bootstrap), the peer manager's ban lifecycle, and RPC rate limiting.
+
+Refs: lighthouse_network/src/discovery/mod.rs + discovery/enr.rs (ENR +
+lookup), peer_manager/mod.rs (ban lifecycle, reconnect suppression),
+rpc/rate_limiter.rs (per-peer per-protocol token buckets).
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.network.discovery import (
+    ENR,
+    DiscoveryService,
+    RoutingTable,
+    log_distance,
+)
+from lighthouse_tpu.network.peer_manager import (
+    BAN_THRESHOLD,
+    PeerManager,
+)
+from lighthouse_tpu.network.rate_limiter import (
+    Quota,
+    RateLimiter,
+    request_cost,
+)
+from lighthouse_tpu.network.socket_transport import SocketTransport
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module", autouse=True)
+def oracle_backend():
+    prev = bls.get_backend()
+    bls.set_backend("oracle")
+    yield
+    bls.set_backend(prev)
+
+
+def _wait_for(cond, timeout=8.0, step=0.05):
+    """Poll a condition with a deadline (UDP + verification threads need
+    real time on a loaded single-core host; fixed sleeps are flaky)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# ENR + routing table
+# ---------------------------------------------------------------------------
+
+
+def test_enr_sign_verify_roundtrip():
+    d = DiscoveryService(fork_digest=b"\x01\x02\x03\x04", tcp_port=9100)
+    try:
+        enr = d.enr
+        assert enr.verify()
+        enr2, off = ENR.decode(enr.encode())
+        assert off == len(enr.encode())
+        assert enr2.verify()
+        assert enr2.node_id == enr.node_id
+        assert enr2.tcp_addr == enr.tcp_addr
+        # tampering breaks the signature
+        raw = bytearray(enr.encode())
+        raw[11] ^= 0xFF  # inside fork_digest
+        bad, _ = ENR.decode(bytes(raw))
+        assert not bad.verify()
+    finally:
+        d.stop()
+
+
+def test_routing_table_distance_buckets():
+    local = b"\x00" * 32
+    t = RoutingTable(local)
+    a = ENR(1, b"\x00" * 4, "127.0.0.1", 1, 1, b"\xaa" * 48)
+    b = ENR(1, b"\x00" * 4, "127.0.0.1", 2, 2, b"\xbb" * 48)
+    assert t.admit(a) and t.admit(b)
+    assert len(t) == 2
+    da = log_distance(local, a.node_id)
+    assert any(e.node_id == a.node_id for e in t.at_distance(da))
+    # closest sorts by XOR distance to the target
+    assert t.closest(a.node_id, 1)[0].node_id == a.node_id
+    t.remove(a.node_id)
+    assert len(t) == 1
+
+
+def test_wrong_fork_digest_rejected():
+    d1 = DiscoveryService(fork_digest=b"\x01\x01\x01\x01").start()
+    d2 = DiscoveryService(fork_digest=b"\x02\x02\x02\x02").start()
+    try:
+        d1.bootstrap(d2.enr)
+        time.sleep(1.0)  # give d2's PONG time to arrive (and be rejected)
+        assert len(d1.table) == 0  # wrong fork digest never admitted
+    finally:
+        d1.stop()
+        d2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Transitive discovery: bootstrap from one node, find a third
+# ---------------------------------------------------------------------------
+
+
+def test_transitive_discovery_via_boot_node():
+    fork = b"\x09\x09\x09\x09"
+    boot = DiscoveryService(fork_digest=fork).start()
+    c = DiscoveryService(fork_digest=fork, tcp_port=9302).start()
+    b = DiscoveryService(fork_digest=fork, tcp_port=9301).start()
+    try:
+        # C announces itself to the boot node first
+        c.bootstrap(boot.enr)
+        assert _wait_for(lambda: len(boot.table) == 1)
+        # B knows ONLY the boot node; a lookup must surface C transitively
+        b.bootstrap(boot.enr)
+        assert _wait_for(lambda: len(b.table) >= 1)
+
+        def found_c():
+            b.lookup(timeout=1.0)
+            return c.enr.node_id in {
+                e.node_id for e in b.table.all_records()
+            }
+
+        assert _wait_for(found_c, timeout=12.0, step=0.2), (
+            "lookup did not discover the third node"
+        )
+        assert "127.0.0.1:9302" in b.known_tcp_addrs()
+    finally:
+        boot.stop()
+        b.stop()
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# Peer manager: ban lifecycle + reconnect suppression
+# ---------------------------------------------------------------------------
+
+
+def test_peer_manager_ban_lifecycle():
+    now = [0.0]
+    pm = PeerManager(clock=lambda: now[0])
+    assert pm.on_connect("1.2.3.4:9000")
+    pm.report("1.2.3.4:9000", BAN_THRESHOLD)  # straight to the threshold
+    assert pm.is_banned(addr="1.2.3.4:9000")
+    assert pm.state("1.2.3.4:9000") == "banned"
+    # reconnects are refused while banned
+    assert not pm.on_connect("1.2.3.4:9000")
+    # ban expires; the peer is forgiven but starts penalized
+    now[0] = 1000.0
+    assert not pm.is_banned(addr="1.2.3.4:9000")
+    assert pm.on_connect("1.2.3.4:9000")
+    assert pm.score("1.2.3.4:9000") <= BAN_THRESHOLD / 2
+
+
+class _NullService:
+    def on_gossip(self, *a):
+        pass
+
+    def on_rpc(self, method, payload, from_peer):
+        from lighthouse_tpu.network.transport import Status
+
+        if method == "status":
+            return Status(b"\x00" * 4, b"\x00" * 32, 0, b"\x00" * 32, 0)
+        return []
+
+    def local_status(self):
+        return None
+
+
+def _transport(spec, discovery=None):
+    t = SocketTransport(spec, rpc_timeout=2.0, discovery=discovery)
+    t.register(t.local_addr, _NullService())
+    return t
+
+
+def test_banned_peer_stays_out_of_transport_and_table():
+    spec = minimal_spec()
+    fork = b"\x07\x07\x07\x07"
+    d_a = DiscoveryService(fork_digest=fork).start()
+    d_b = DiscoveryService(fork_digest=fork).start()
+    a = _transport(spec, discovery=d_a)
+    bt = _transport(spec, discovery=d_b)
+    try:
+        d_a.bootstrap(d_b.enr)
+        assert _wait_for(lambda: len(d_a.table) == 1)
+        assert a.discover_enr(), "ENR discovery found no peers"
+        assert _wait_for(lambda: bt.local_addr in a.peers())
+        # ban B at A: connection drops, table forgets it, dial refuses
+        a.report_peer(bt.local_addr, BAN_THRESHOLD)
+        assert _wait_for(lambda: bt.local_addr not in a.peers())
+        assert a.peer_manager.is_banned(addr=bt.local_addr)
+        assert bt.local_addr not in a.discovery.known_tcp_addrs()
+        assert not a.dial(bt.local_addr)
+        assert a.discover_enr() is not None  # lookup must not re-admit
+        assert bt.local_addr not in a.peers()
+        # B dialing A is cut at HELLO (reconnect suppression)
+        assert bt.dial(a.local_addr)
+        time.sleep(1.0)
+        assert bt.local_addr not in a.peers()
+    finally:
+        a.stop()
+        bt.stop()
+        d_a.stop()
+        d_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# RPC rate limiting
+# ---------------------------------------------------------------------------
+
+
+def test_rate_limiter_buckets_and_refill():
+    now = [0.0]
+    rl = RateLimiter({"blocks_by_range": Quota(100, 10.0)},
+                     clock=lambda: now[0])
+    # a full-quota request passes, the next is refused
+    assert rl.allow("p1", "blocks_by_range", 100)
+    assert not rl.allow("p1", "blocks_by_range", 1)
+    # other peers are unaffected
+    assert rl.allow("p2", "blocks_by_range", 50)
+    # oversized single requests always refused
+    assert not rl.allow("p3", "blocks_by_range", 101)
+    # refill over time
+    now[0] = 5.0
+    assert rl.allow("p1", "blocks_by_range", 49)
+    assert not rl.allow("p1", "blocks_by_range", 2)
+
+
+def test_request_cost_scales_with_batch():
+    # codec form: (start_slot, count)
+    assert request_cost("blocks_by_range", (100, 64)) == 64.0
+
+    class P:
+        count = 32
+
+    assert request_cost("blocks_by_range", P()) == 32.0
+    assert request_cost("blocks_by_root", [b"r"] * 5) == 5.0
+    assert request_cost("status", object()) == 1.0
+
+
+def test_flooding_peer_throttled_then_dropped_honest_unaffected():
+    spec = minimal_spec()
+    a = _transport(spec)
+    flooder = _transport(spec)
+    honest = _transport(spec)
+    # tighten the status quota so the test floods quickly
+    a.rate_limiter.quotas["status"] = Quota(3, 60.0)
+    try:
+        assert flooder.dial(a.local_addr)
+        assert honest.dial(a.local_addr)
+        time.sleep(0.3)
+        from lighthouse_tpu.network.transport import Status
+
+        st = Status(b"\x00" * 4, b"\x00" * 32, 0, b"\x00" * 32, 0)
+        # first requests pass
+        for _ in range(3):
+            flooder.request(flooder.local_addr, a.local_addr, "status", st)
+        # sustained flood: refused with 'rate limited', then banned+dropped
+        refused = dropped = False
+        for _ in range(10):
+            try:
+                flooder.request(
+                    flooder.local_addr, a.local_addr, "status", st
+                )
+            except ConnectionError as e:
+                if "rate limited" in str(e):
+                    refused = True
+                else:
+                    dropped = True
+                    break
+            time.sleep(0.05)
+        assert refused, "flooder was never refused"
+        assert dropped or a.peer_manager.is_banned(addr=flooder.local_addr)
+        time.sleep(0.2)
+        assert flooder.local_addr not in a.peers()
+        # the honest peer still gets service
+        honest.request(honest.local_addr, a.local_addr, "status", st)
+        assert honest.local_addr in a.peers()
+    finally:
+        a.stop()
+        flooder.stop()
+        honest.stop()
