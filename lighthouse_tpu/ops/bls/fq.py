@@ -191,9 +191,10 @@ def _carry_rounds(t, rounds: int):
     """Width-preserving carry-save rounds: limb bound b -> 0xFFFF + (b >> 16)
     per round (value invariant; the top limb's carry is statically zero when
     the value fits the width — limbs are non-negative so
-    limb[-1] <= value >> (16*(n-1)))."""
+    limb[-1] <= value >> (16*(n-1))). Dtype-generic (u64 masks / f64 floor)."""
     for _ in range(rounds):
-        t = (t & MASK) + _shift_up_one(t >> np.uint64(LIMB_BITS))
+        lo, hi = _split16(t)
+        t = lo + _shift_up_one(hi)
     return t
 
 
@@ -229,7 +230,7 @@ def _cond_sub_p(a):
     return jnp.where((borrow == 1)[..., None], a, diff)
 
 
-def _conv_product(a, b):
+def _conv_product_shear(a, b):
     """Schoolbook 25x25 convolution -> 50 uint64 accumulators. Exact for limbs up
     to 2^22 (25 * 2^44 < 2^50).
 
@@ -250,6 +251,153 @@ def _conv_product(a, b):
     return jnp.pad(t, [(0, 0)] * len(batch) + [(0, 1)])
 
 
+def _conv_product_f64(a, b):
+    """Schoolbook convolution as a 25-term shifted-FMA chain in f64.
+
+    Products are exact: conv inputs satisfy the lazy budget (limbs < 2^22), so
+    every accumulator is < 25 * 2^44 < 2^49 < 2^53 (f64 integer exactness).
+    The FMA chain fuses into one pass over the [..., 49] output — the
+    shear-reshape form above materializes the full [..., 25, 50] outer
+    product (160 MB at batch 16k) and is memory-bound at ~3x the runtime.
+    Compile cost of the 25-term chain is ~0.2 s (the r3 compile blowup came
+    from while-loops, not op count)."""
+    af = a.astype(jnp.float64)
+    bf = b.astype(jnp.float64)
+    nb = [(0, 0)] * (a.ndim - 1)
+    t = None
+    for i in range(NLIMBS):
+        term = jnp.pad(af[..., i : i + 1] * bf, nb + [(i, NLIMBS - 1 - i)])
+        t = term if t is None else t + term
+    # materialization fence: the chain is fully elementwise, and without the
+    # barrier XLA CPU duplicates it into every consumer of the accumulators
+    # inside large fused graphs (measured 1.7x slower map_to_g2)
+    t = jax.lax.optimization_barrier(t)
+    return jnp.pad(t, nb + [(0, 1)])
+
+
+def _conv_product_f64_u64(a, b):
+    return _conv_product_f64(a, b).astype(jnp.uint64)
+
+
+# TPU digit path: base-2^8 digit split. Limb i (< 2^22) contributes bytes to
+# digit positions 2i, 2i+1, 2i+2; overlapping chunks add, so digits are
+# <= 255 + (limb >> 16) <= 318. 51 digits cover 25 limbs.
+_N_DIGITS = 2 * NLIMBS + 1  # 51
+
+
+def _digit_bound(limb_bound: int) -> int:
+    return min(limb_bound, 255) + (limb_bound >> 16)
+
+
+def _to_digits_f32(x):
+    """u64 limbs [..., 25] -> f32 digits [..., 51] (base 2^8, overlap-added):
+    digit[2i] = c0(i) + c2(i-1), digit[2i+1] = c1(i), digit[50] = c2(24)."""
+    c0 = (x & jnp.uint64(0xFF)).astype(jnp.float32)
+    c1 = ((x >> jnp.uint64(8)) & jnp.uint64(0xFF)).astype(jnp.float32)
+    c2 = (x >> jnp.uint64(16)).astype(jnp.float32)
+    nb = [(0, 0)] * (x.ndim - 1)
+    # even digit slots 0..25: c0 padded with a tail slot + c2 shifted up one
+    even = jnp.pad(c0, nb + [(0, 1)]) + jnp.pad(c2, nb + [(1, 0)])
+    odd = jnp.pad(c1, nb + [(0, 1)])  # odd digit slots 1,3,..,49 (+ unused)
+    inter = jnp.stack([even, odd], axis=-1)  # [..., 26, 2]
+    d = inter.reshape(x.shape[:-1] + (2 * (NLIMBS + 1),))  # 52 slots
+    return d[..., : _N_DIGITS]  # slot 51 (odd tail) is zero by construction
+
+
+def _conv_product_digits(a, b):
+    """TPU convolution: f32 digit-split shifted-FMA chain, recombined to the
+    u64 16-bit-limb accumulator layout.
+
+    TPUs have no fast 64-bit integer multiply (u64 lowers to multi-op u32
+    emulation on the VPU) and f64 is software-emulated, but f32 FMA runs at
+    full VPU rate. Digits are <= 318 (for 2^22-bounded limbs) so every conv
+    accumulator is <= 51 * 318^2 < 2^23 — exact in f32. The recombined limb
+    accumulators are < 2^31.4, a TIGHTER bound than the f64 path's 2^48.6,
+    which shortens the fold schedule downstream."""
+    da = _to_digits_f32(a)
+    db = _to_digits_f32(b)
+    nb = [(0, 0)] * (a.ndim - 1)
+    t = None
+    for i in range(_N_DIGITS):
+        term = jnp.pad(da[..., i : i + 1] * db, nb + [(i, _N_DIGITS - 1 - i)])
+        t = term if t is None else t + term
+    # digit accumulators [..., 101] -> u64 limbs: limb s = D[2s] + 2^8 D[2s+1]
+    t = jnp.pad(t, nb + [(0, 1)])  # 102 digit slots = 51 limb pairs
+    ti = t.astype(jnp.uint32).astype(jnp.uint64)
+    pairs = ti.reshape(t.shape[:-1] + (_N_DIGITS, 2))
+    limbs = pairs[..., 0] + (pairs[..., 1] << jnp.uint64(8))
+    # digit position 100 (top-chunk x top-chunk) lands at limb 50, one past
+    # the 50-limb accumulator layout; fold it into limb 49 (value-preserving,
+    # bound ~2^32 — still far inside u64)
+    spill = limbs[..., 2 * NLIMBS :] << jnp.uint64(LIMB_BITS)
+    return jnp.concatenate(
+        [limbs[..., : 2 * NLIMBS - 1], limbs[..., 2 * NLIMBS - 1 : 2 * NLIMBS] + spill],
+        axis=-1,
+    )
+
+
+_CONV_IMPL = None
+
+
+def conv_backend() -> str:
+    """Which conv implementation the default backend gets: "digits" on TPU
+    (f32 VPU path), "f64" elsewhere (CPU SIMD FMA). Cached on first use;
+    override via LIGHTHOUSE_CONV_IMPL=digits|f64|shear for testing."""
+    global _CONV_IMPL
+    if _CONV_IMPL is None:
+        import os
+
+        forced = os.environ.get("LIGHTHOUSE_CONV_IMPL")
+        if forced in ("digits", "f64", "shear"):
+            _CONV_IMPL = forced
+        else:
+            _CONV_IMPL = "digits" if jax.default_backend() == "tpu" else "f64"
+    return _CONV_IMPL
+
+
+def conv_limb_bounds(in_limb_a: int, in_limb_b: int | None = None) -> list[int]:
+    """Static per-accumulator bounds of _conv_product for inputs with limbs
+    <= in_limb_a / in_limb_b under the active conv backend, asserting
+    float-exactness of the chosen path."""
+    if in_limb_b is None:
+        in_limb_b = in_limb_a
+    if conv_backend() == "digits":
+        da = _digit_bound(in_limb_a)
+        db = _digit_bound(in_limb_b)
+        # digit conv position d has min(d, 100-d, 50)+1 terms
+        per_digit = [
+            (min(d, 2 * _N_DIGITS - 2 - d, _N_DIGITS - 1) + 1) * da * db
+            for d in range(2 * _N_DIGITS - 1)
+        ] + [0]
+        assert max(per_digit) < 1 << 24, "digit conv exceeds f32 exactness"
+        limb_b = [
+            per_digit[2 * s] + (per_digit[2 * s + 1] << 8)
+            for s in range(_N_DIGITS)
+        ]
+        # limb 50 is folded into limb 49 by _conv_product_digits
+        limb_b[2 * NLIMBS - 1] += limb_b[2 * NLIMBS] << LIMB_BITS
+        return limb_b[: 2 * NLIMBS]
+    bounds = [
+        max(1, min(i + 1, NLIMBS, 2 * NLIMBS - 1 - i)) * in_limb_a * in_limb_b
+        for i in range(2 * NLIMBS)
+    ]
+    if conv_backend() == "f64":
+        assert max(bounds) < 1 << 53, "f64 conv exceeds f64 exactness"
+    return bounds
+
+
+def _conv_product(a, b):
+    """Convolution product -> 50 u64 accumulators (platform-dispatched; see
+    _conv_product_f64 / _conv_product_digits / _conv_product_shear). Inputs
+    must satisfy the lazy budget: limbs < 2^22, value < 1200p."""
+    impl = conv_backend()
+    if impl == "digits":
+        return _conv_product_digits(a, b)
+    if impl == "f64":
+        return _conv_product_f64_u64(a, b)
+    return _conv_product_shear(a, b)
+
+
 # Congruence-fold rows: _FOLD_ROWS[j] = 16-bit limbs of 2^(16*(25+j)) mod p.
 # Folding limb 25+j through its row is an exact congruence mod p.
 _N_FOLD = 40
@@ -257,6 +405,7 @@ _FOLD_NP = np.stack(
     [int_to_limbs((1 << (LIMB_BITS * (NLIMBS + j))) % P) for j in range(_N_FOLD)]
 )
 _FOLD_ROWS = jnp.asarray(_FOLD_NP)
+_FOLD_ROWS_F64 = jnp.asarray(_FOLD_NP.astype(np.float64))
 _FOLD_VALS = [(1 << (LIMB_BITS * (NLIMBS + j))) % P for j in range(_N_FOLD)]
 
 PUB_VALUE_LIMIT = 13 * P  # reduce() output value bound (plans.PUB_BOUND holds)
@@ -279,10 +428,28 @@ class _RState:
         self.value = value
 
 
+def _is_f64(t) -> bool:
+    return t.dtype == jnp.float64
+
+
+def _cap_of(t) -> int:
+    """Largest exactly-representable accumulator bound for t's dtype: integer
+    f64 stays exact below 2^53; u64 wraps at 2^64."""
+    return (1 << 53) if _is_f64(t) else (1 << 64)
+
+
+def _split16(t):
+    """(low 16 bits, value >> 16) in t's dtype. The f64 form is exact for
+    integer t < 2^53 (scaling by 2^-16 and floor are exact)."""
+    if _is_f64(t):
+        hi = jnp.floor(t * (1.0 / 65536.0))
+        return t - hi * 65536.0, hi
+    return t & MASK, t >> np.uint64(LIMB_BITS)
+
+
 def _carry_round_array(t):
     """One elementwise carry-save round (appends a limb; value unchanged)."""
-    lo = t & MASK
-    hi = t >> np.uint64(LIMB_BITS)
+    lo, hi = _split16(t)
     nb = [(0, 0)] * (t.ndim - 1)
     return jnp.pad(lo, nb + [(0, 1)]) + jnp.pad(hi, nb + [(1, 0)])
 
@@ -296,26 +463,32 @@ def _carry_round(t, s: _RState):
 
 def _fold_high(t, s: _RState):
     """Fold limbs >= 25 through the 2^(16k) mod p rows — an exact congruence
-    mod p that shrinks the value by ~2^19x per live high limb."""
+    mod p that shrinks the value by ~2^19x per live high limb. Unrolled
+    broadcast-FMA terms (not a .sum(-2) reduction) so XLA fuses the fold into
+    the surrounding elementwise chain — the reduction form materialized the
+    [..., n_hi, 25] intermediate and cost an extra memory pass."""
     n_hi = t.shape[-1] - NLIMBS
-    lo, hi = t[..., :NLIMBS], t[..., NLIMBS:]
-    t = lo + (hi[..., :, None] * _FOLD_ROWS[:n_hi]).sum(-2)
+    rows = _FOLD_ROWS_F64 if _is_f64(t) else _FOLD_ROWS
+    acc = t[..., :NLIMBS]
+    for j in range(n_hi):
+        acc = acc + t[..., NLIMBS + j : NLIMBS + j + 1] * rows[j]
     lo_b, hi_b = s.limbs[:NLIMBS], s.limbs[NLIMBS:]
     limbs = [
         b + sum(hb * int(_FOLD_NP[j, i]) for j, hb in enumerate(hi_b))
         for i, b in enumerate(lo_b)
     ]
-    assert max(limbs) < 1 << 64, "fold accumulator overflow"
+    assert max(limbs) < _cap_of(t), "fold accumulator overflow"
     lo_val = sum(b << (LIMB_BITS * i) for i, b in enumerate(lo_b))
     value = min(s.value, lo_val) + sum(
         hb * _FOLD_VALS[j] for j, hb in enumerate(hi_b)
     )
-    return t, _RState(limbs, value)
+    return acc, _RState(limbs, value)
 
 
 _RT384_VAL = (1 << 384) % P
 _RT384_NP = int_to_limbs(_RT384_VAL)
 _RT384_ROW = jnp.asarray(_RT384_NP)
+_RT384_ROW_F64 = jnp.asarray(_RT384_NP.astype(np.float64))
 _RT381_VAL = (1 << 381) % P
 _RT381_ROW = jnp.asarray(int_to_limbs(_RT381_VAL))
 # keep bits < 381: full limbs 0..22, 13 bits of limb 23, none of limb 24
@@ -329,17 +502,21 @@ _MASK_LOW381 = jnp.asarray(
 _MASK_NO24 = jnp.asarray(
     np.array([1] * 24 + [0], dtype=np.uint64)
 )
+_MASK_NO24_F64 = jnp.asarray(np.array([1.0] * 24 + [0.0]))
 
 
 def _fold_384(t, s: _RState):
     """Fold the 2^384-and-up excess of a 25-limb array through 2^384 mod p."""
     top = t[..., 24]
-    t = t * _MASK_NO24 + top[..., None] * _RT384_ROW
+    if _is_f64(t):
+        t = t * _MASK_NO24_F64 + top[..., None] * _RT384_ROW_F64
+    else:
+        t = t * _MASK_NO24 + top[..., None] * _RT384_ROW
     top_b = s.limbs[24]
     limbs = [
         b + top_b * int(_RT384_NP[i]) for i, b in enumerate(s.limbs[:24])
     ] + [top_b * int(_RT384_NP[24])]
-    assert max(limbs) < 1 << 64, "fold384 accumulator overflow"
+    assert max(limbs) < _cap_of(t), "fold384 accumulator overflow"
     lo_val = sum(b << (LIMB_BITS * i) for i, b in enumerate(s.limbs[:24]))
     return t, _RState(limbs, min(s.value, lo_val) + top_b * _RT384_VAL)
 
@@ -382,7 +559,11 @@ def _drop_zero_tops(t, s: _RState):
 def reduce_limbs(t, limb_bounds, value_bound: int):
     """Reduce [..., N] (N >= 25) to plans.PUB_BOUND: value < 13p, 17-bit limbs,
     top limb <= 2. Statically scheduled congruence folds + elementwise carry
-    rounds — fully while-free; bounds proved at trace time."""
+    rounds — fully while-free; bounds proved at trace time. Dtype-generic:
+    an f64 input runs the whole walk in f64 (exactness cap 2^53 instead of
+    2^64 — a slightly longer schedule of cheaper, fusion-friendly FMA steps)
+    and is cast to u64 at the end."""
+    cap = _cap_of(t)
     s = _RState(list(limb_bounds), value_bound)
     # phase 1: fold down to 25 limbs
     for _ in range(64):
@@ -393,7 +574,7 @@ def reduce_limbs(t, limb_bounds, value_bound: int):
         prod = max(s.limbs[:NLIMBS]) + sum(
             hb * int(MASK) for hb in s.limbs[NLIMBS:]
         )
-        if n_hi <= _N_FOLD and prod < 1 << 64:
+        if n_hi <= _N_FOLD and prod < cap:
             t, s = _fold_high(t, s)
         else:
             t, s = _carry_round(t, s)
@@ -409,7 +590,7 @@ def reduce_limbs(t, limb_bounds, value_bound: int):
             prod = max(s.limbs[:NLIMBS]) + sum(
                 hb * int(MASK) for hb in s.limbs[NLIMBS:]
             )
-            if prod < 1 << 64:
+            if prod < cap:
                 t, s = _fold_high(t, s)
             else:
                 t, s = _carry_round(t, s)
@@ -420,7 +601,7 @@ def reduce_limbs(t, limb_bounds, value_bound: int):
                 b << (LIMB_BITS * i) for i, b in enumerate(s.limbs[:24])
             )
             predicted = min(s.value, lo_val) + s.limbs[24] * _RT384_VAL
-            safe = s.limbs[24] * int(MASK) + max(s.limbs[:24]) < 1 << 64
+            safe = s.limbs[24] * int(MASK) + max(s.limbs[:24]) < cap
             if safe and predicted < s.value:
                 t, s = _fold_384(t, s)
             else:
@@ -435,6 +616,8 @@ def reduce_limbs(t, limb_bounds, value_bound: int):
     assert s.value <= PUB_VALUE_LIMIT
     assert max(s.limbs) <= PUB_LIMB_TARGET
     assert min(s.limbs[24], s.value >> (LIMB_BITS * 24)) <= 2
+    if _is_f64(t):
+        t = t.astype(jnp.uint64)  # exact: limbs <= 2^17
     return t
 
 
@@ -444,6 +627,8 @@ _IN_VALUE = 1200 * P
 
 
 def _conv_limb_bounds(lb: int):
+    """Backend-independent worst-case accumulator bounds (the u64/f64 shape);
+    retained for probes. Prefer conv_limb_bounds, which is backend-aware."""
     return [max(1, min(i + 1, NLIMBS, 49 - i)) * lb * lb for i in range(2 * NLIMBS)]
 
 
@@ -451,9 +636,14 @@ def mont_mul(a, b):
     """Product a*b mod p (plain domain — the historical name is kept for the
     call sites). Operands may be lazy up to _IN_VALUE (1200p) with limbs up to
     _IN_LIMB (2^22); output satisfies plans.PUB_BOUND (< 13p, 16-bit limbs,
-    top <= 2)."""
+    top <= 2).
+
+    The conv runs in f64 (CPU) / f32 digits (TPU) and is cast back to u64 for
+    the fold walk — the cast doubles as a fusion barrier; an all-f64 fused
+    conv+reduce graph made XLA CPU recompute the conv chain per consumer
+    (measured 6x slower)."""
     t = _conv_product(a, b)
-    return reduce_limbs(t, _conv_limb_bounds(_IN_LIMB), _IN_VALUE * _IN_VALUE)
+    return reduce_limbs(t, conv_limb_bounds(_IN_LIMB), _IN_VALUE * _IN_VALUE)
 
 
 def mont_sqr(a):

@@ -443,7 +443,7 @@ def execute(plan: Plan, a, b, in_bound_a=PUB_BOUND, in_bound_b=PUB_BOUND, name="
     T = fq._conv_product(A, B)  # [..., L, 50] unreduced accumulators
     # one elementwise carry round caps limbs (~2^33) so out-row accumulation
     # and subtraction covers stay inside uint64
-    conv_limb = 25 * ba.limb * bb.limb
+    conv_limb = max(fq.conv_limb_bounds(ba.limb, bb.limb))
     assert conv_limb < 1 << 63, f"{name}: conv accumulator overflow"
     lane_limb = (1 << 16) + (conv_limb >> 16)
     T = fq._carry_round_array(T)  # [..., L, 51]
