@@ -98,6 +98,18 @@ class BeaconChain:
         )
         self.pubkey_cache = ValidatorPubkeyCache()
         self.pubkey_cache.import_new_pubkeys(genesis_state)
+        # attester/shuffling cache tier (firehose/attester_cache.py): gossip
+        # committee resolution without cloning or slot-advancing full states
+        from ..firehose.attester_cache import AttesterCacheTier
+
+        self.attester_cache = AttesterCacheTier(
+            spec,
+            genesis_validators_root=bytes(genesis_state.genesis_validators_root),
+            ancestor_at_slot=self._known_ancestor_at_slot,
+            state_fallback=self._state_for_committee,
+        )
+        # firehose hot path prunes the naive pool at most once per slot
+        self._naive_pool_pruned_slot = -1
 
         # genesis anchor: the canonical block root needs the header's
         # state_root filled (it is zero until the next process_slot)
@@ -670,6 +682,65 @@ class BeaconChain:
 
     # -- attestations ---------------------------------------------------------------
 
+    def _known_ancestor_at_slot(self, root: bytes, slot: int) -> bytes | None:
+        """Fork-choice ancestor walk for the attester-cache decision key;
+        None for blocks fork choice does not know (cache unusable)."""
+        if root not in self.fork_choice.proto.indices:
+            return None
+        return self.fork_choice._ancestor_at_slot(root, slot)
+
+    def _state_for_committee(self, block_root: bytes, slot: int):
+        """Shuffling-cache miss path: a state of the attestation's chain
+        advanced to its slot (the pre-cache full-state behavior)."""
+        state = self._states.get(bytes(block_root))
+        if state is None:
+            return None
+        if state.slot < slot:
+            state = state.copy()
+            process_slots(self.spec, state, slot)
+        return state
+
+    def _committee_and_indexed(self, att):
+        """(committee, indexed attestation) with ONE committee lookup
+        through the attester-cache tier — no state clone or slot advance on
+        the hot path. Electra committee_bits attestations (multi-committee)
+        take the full-state path."""
+        if hasattr(att, "committee_bits"):
+            state = self._attestation_state(att)
+            from ..state_transition import get_beacon_committee
+
+            committee = get_beacon_committee(
+                self.spec, state, int(att.data.slot), int(att.data.index)
+            )
+            return committee, get_indexed_attestation(self.spec, state, att)
+        committee = self.attester_cache.committee_for(att.data)
+        if committee is None:
+            raise AttestationError("unknown beacon block root")
+        bits = np.asarray(att.aggregation_bits, dtype=bool)
+        if bits.size != committee.size:
+            raise AttestationError(
+                "aggregation bits length != committee size"
+            )
+        indexed = self.ns.IndexedAttestation(
+            attesting_indices=sorted(int(i) for i in committee[bits]),
+            data=att.data,
+            signature=att.signature,
+        )
+        return committee, indexed
+
+    def _indexed_attestation_fast(self, att):
+        return self._committee_and_indexed(att)[1]
+
+    def _attester_item_fast(self, indexed):
+        """(indices, signing root, signature bytes) from the cache tier's
+        state-free domain (schedule fork version + genesis validators root
+        — identical to get_domain for any on-schedule state)."""
+        return (
+            [int(i) for i in indexed.attesting_indices],
+            self.attester_cache.signing_root(indexed.data),
+            bytes(indexed.signature),
+        )
+
     def _batch_verify_items(self, items) -> bool:
         """Verify (validator_indices, message, signature_bytes) triples as one
         RLC batch. On the tpu backend this is the fully-fused device path:
@@ -719,16 +790,19 @@ class BeaconChain:
 
     def verify_unaggregated_attestations(self, attestations) -> list:
         """Batch gossip verification: one signature set per attestation, one
-        bls batch; on failure re-verify individually
+        bls batch; a poisoned batch is isolated by bisection (split-and-retry,
+        firehose/bisect.py) instead of n per-set re-verifies
         (batch_verify_unaggregated_attestations, batch.rs:133-211).
-        Returns list of (attestation, indexed | error)."""
+        Committee resolution rides the attester-cache tier — no state clone
+        on the hot path. Returns list of (attestation, indexed | error)."""
+        from ..firehose.bisect import bisect_verify
+
         prepared = []
         with ATTESTATION_BATCH_SETUP_TIMES.time():
             for att in attestations:
                 try:
-                    state = self._attestation_state(att)
-                    indexed = get_indexed_attestation(self.spec, state, att)
-                    item = self._attester_item(state, indexed)
+                    indexed = self._indexed_attestation_fast(att)
+                    item = self._attester_item_fast(indexed)
                     prepared.append((att, indexed, item))
                 except Exception as e:
                     prepared.append((att, AttestationError(str(e)), None))
@@ -738,11 +812,19 @@ class BeaconChain:
             for att, indexed, _ in prepared:
                 results.append((att, indexed))
         else:
-            # poisoned batch: per-set fallback keeps exact error fidelity
+            # poisoned batch: bisection isolates the bad set(s) in
+            # O(bad * log n) batched calls with exact error fidelity
+            verdicts = iter(
+                bisect_verify(
+                    [[item] for item in items],
+                    self._batch_verify_items,
+                    assume_failed=bool(items),
+                )
+            )
             for att, indexed, item in prepared:
                 if item is None:
                     results.append((att, indexed))
-                elif self._batch_verify_items([item]):
+                elif next(verdicts):
                     results.append((att, indexed))
                 else:
                     results.append(
@@ -764,78 +846,93 @@ class BeaconChain:
             self.naive_aggregation_pool.prune(self.current_slot())
         return results
 
+    def _prepare_aggregate(self, sap):
+        """Signature-set group (selection proof, envelope, attester set) for
+        one SignedAggregateAndProof via the attester-cache tier — raises
+        AttestationError on any pre-crypto rejection."""
+        from ..ssz import uint64 as ssz_u64
+        from ..types.containers import SigningData
+        from ..types.helpers import compute_signing_root
+
+        agg = sap.message
+        att = agg.aggregate
+        committee, indexed = self._committee_and_indexed(att)
+        aggor = int(agg.aggregator_index)
+        if self.pubkey_cache.get(aggor) is None:
+            raise AttestationError("unknown aggregator index")
+        # spec is_aggregator: the selection proof must actually
+        # select this validator for the committee (the signature
+        # check alone lets ANY committee member aggregate)
+        import hashlib as _hl
+
+        if aggor not in [int(v) for v in committee]:
+            raise AttestationError("aggregator not in committee")
+        modulo = max(
+            1,
+            committee.size // self.spec.target_aggregators_per_committee,
+        )
+        digest = _hl.sha256(bytes(agg.selection_proof)).digest()
+        if int.from_bytes(digest[0:8], "little") % modulo != 0:
+            raise AttestationError("selection proof does not select")
+        epoch = self.spec.compute_epoch_at_slot(att.data.slot)
+        root_sel = SigningData(
+            object_root=ssz_u64.hash_tree_root(att.data.slot),
+            domain=self._domain_at(self.spec.DOMAIN_SELECTION_PROOF, epoch),
+        ).tree_root()
+        root_ap = compute_signing_root(
+            agg, self._domain_at(self.spec.DOMAIN_AGGREGATE_AND_PROOF, epoch)
+        )
+        items = [
+            ([aggor], root_sel, bytes(agg.selection_proof)),
+            ([aggor], root_ap, bytes(sap.signature)),
+            self._attester_item_fast(indexed),
+        ]
+        return indexed, items
+
+    def _domain_at(self, domain_type: bytes, epoch: int) -> bytes:
+        """State-free signing domain from the fork schedule + genesis
+        validators root (equals get_domain for on-schedule states)."""
+        from ..types.helpers import compute_domain
+
+        return compute_domain(
+            domain_type,
+            self.spec.fork_version_at_epoch(int(epoch)),
+            self.attester_cache.genesis_validators_root,
+        )
+
     def verify_aggregated_attestations(self, signed_aggregates) -> list:
         """Gossip aggregate verification: THREE signature sets per
         SignedAggregateAndProof — selection proof, aggregate-and-proof
-        envelope, and the indexed attestation — batched across aggregates with
-        per-aggregate fallback on poisoned batches
+        envelope, and the indexed attestation — batched across aggregates;
+        a poisoned batch bisects down to the bad aggregate group(s)
         (batch_verify_aggregated_attestations, batch.rs:28-113).
         Returns list of (signed_aggregate, indexed | error)."""
-        from ..ssz import uint64 as ssz_u64
-        from ..types.containers import SigningData
-        from ..types.helpers import compute_signing_root, get_domain
+        from ..firehose.bisect import bisect_verify
 
         prepared = []
         for sap in signed_aggregates:
             try:
-                agg = sap.message
-                att = agg.aggregate
-                state = self._attestation_state(att)
-                indexed = get_indexed_attestation(self.spec, state, att)
-                aggor = int(agg.aggregator_index)
-                if self.pubkey_cache.get(aggor) is None:
-                    raise AttestationError("unknown aggregator index")
-                # spec is_aggregator: the selection proof must actually
-                # select this validator for the committee (the signature
-                # check alone lets ANY committee member aggregate)
-                import hashlib as _hl
-
-                from ..state_transition import get_beacon_committee
-
-                committee = get_beacon_committee(
-                    self.spec, state, int(att.data.slot), int(att.data.index)
-                )
-                if aggor not in [int(v) for v in committee]:
-                    raise AttestationError("aggregator not in committee")
-                modulo = max(
-                    1,
-                    committee.size
-                    // self.spec.target_aggregators_per_committee,
-                )
-                digest = _hl.sha256(bytes(agg.selection_proof)).digest()
-                if int.from_bytes(digest[0:8], "little") % modulo != 0:
-                    raise AttestationError("selection proof does not select")
-                epoch = self.spec.compute_epoch_at_slot(att.data.slot)
-                dom_sel = get_domain(
-                    self.spec, state, self.spec.DOMAIN_SELECTION_PROOF, epoch=epoch
-                )
-                root_sel = SigningData(
-                    object_root=ssz_u64.hash_tree_root(att.data.slot),
-                    domain=dom_sel,
-                ).tree_root()
-                dom_ap = get_domain(
-                    self.spec, state,
-                    self.spec.DOMAIN_AGGREGATE_AND_PROOF, epoch=epoch,
-                )
-                root_ap = compute_signing_root(agg, dom_ap)
-                items = [
-                    ([aggor], root_sel, bytes(agg.selection_proof)),
-                    ([aggor], root_ap, bytes(sap.signature)),
-                    self._attester_item(state, indexed),
-                ]
+                indexed, items = self._prepare_aggregate(sap)
                 prepared.append((sap, indexed, items))
             except Exception as e:
                 prepared.append((sap, AttestationError(str(e)), None))
-        all_items = [it for _, _, its in prepared if its for it in its]
+        groups = [its for _, _, its in prepared if its]
+        all_items = [it for g in groups for it in g]
         results = []
         if all_items and self._batch_verify_items(all_items):
             for sap, indexed, _ in prepared:
                 results.append((sap, indexed))
         else:
+            verdicts = iter(
+                bisect_verify(
+                    groups, self._batch_verify_items,
+                    assume_failed=bool(all_items),
+                )
+            )
             for sap, indexed, its in prepared:
                 if its is None:
                     results.append((sap, indexed))
-                elif self._batch_verify_items(its):
+                elif next(verdicts):
                     results.append((sap, indexed))
                 else:
                     results.append(
@@ -852,6 +949,64 @@ class BeaconChain:
                         pass
                     self._notify_attestation_observers(indexed)
         return results
+
+    # -- firehose (streaming gossip verification) ---------------------------------
+
+    def create_firehose(self, config=None, synchronous: bool = False):
+        """Streaming verification engine for the gossip firehose: adaptive
+        batching + double-buffered host/device pipeline + back-pressure,
+        with the host stage wired to the attester-cache tier and the device
+        stage to the batched BLS backend with bisection fallback
+        (firehose/engine.py). Handles BOTH firehose-eligible payload kinds:
+        unaggregated Attestations (one set) and SignedAggregateAndProofs
+        (three sets); verdicts apply to fork choice / the naive pool
+        exactly like the verify_* batch paths."""
+        from ..firehose import FirehoseEngine
+
+        def prepare(payloads):
+            out = []
+            for p in payloads:
+                try:
+                    if hasattr(p, "message"):  # SignedAggregateAndProof
+                        indexed, items = self._prepare_aggregate(p)
+                        out.append((items, indexed))
+                    else:
+                        indexed = self._indexed_attestation_fast(p)
+                        out.append(
+                            ([self._attester_item_fast(indexed)], indexed)
+                        )
+                except Exception as e:  # noqa: BLE001 — pre-crypto rejection
+                    out.append(AttestationError(str(e)))
+            return out
+
+        engine = FirehoseEngine(
+            prepare_fn=prepare,
+            verify_items_fn=self._batch_verify_items,
+            config=config,
+            synchronous=synchronous,
+        )
+        engine.default_callback = self._apply_verified_attestation
+        return engine
+
+    def _apply_verified_attestation(self, payload, ok: bool, indexed) -> None:
+        """Post-verdict application for firehose-verified gossip work (the
+        tail of the verify_* batch paths). Unaggregated attestations also
+        merge into the naive aggregation pool; the pool is pruned at most
+        once per slot (not per item — the stream path is hot)."""
+        if not ok or indexed is None:
+            return
+        with self.lock:
+            try:
+                self.fork_choice.on_attestation(self.current_slot(), indexed)
+            except Exception:
+                pass
+            if not hasattr(payload, "message"):  # unaggregated Attestation
+                self.naive_aggregation_pool.insert(payload)
+            self._notify_attestation_observers(indexed)
+            slot = self.current_slot()
+            if slot != self._naive_pool_pruned_slot:
+                self._naive_pool_pruned_slot = slot
+                self.naive_aggregation_pool.prune(slot)
 
     # -- sync committee messages (sync_committee_verification.rs) ----------
 

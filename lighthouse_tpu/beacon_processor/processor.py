@@ -105,8 +105,12 @@ class BeaconProcessor:
     drain the queues continuously."""
 
     def __init__(self, config: BeaconProcessorConfig | None = None,
-                 synchronous: bool = False):
+                 synchronous: bool = False, firehose=None):
         self.config = config or BeaconProcessorConfig()
+        # optional streaming verification engine (firehose/engine.py):
+        # batchable gossip work WITHOUT explicit handlers routes straight
+        # into its intake instead of the generic queues
+        self.firehose = firehose
         self.queues: dict[WorkType, deque] = {t: deque() for t in WorkType}
         self.dropped: dict[WorkType, int] = {t: 0 for t in WorkType}
         self.processed: dict[WorkType, int] = {t: 0 for t in WorkType}
@@ -127,6 +131,21 @@ class BeaconProcessor:
     # -- submission (back-pressure at enqueue, drop on overflow) -----------------
 
     def submit(self, work: Work) -> bool:
+        if (
+            self.firehose is not None
+            and work.work_type in _BATCHABLE
+            and work.process_individual is None
+            and work.process_batch is None
+        ):
+            # firehose-eligible gossip work: the engine owns batching,
+            # back-pressure and verdict application end to end
+            ok = self.firehose.submit(work.item, work_type=work.work_type)
+            with self._lock:
+                if ok:
+                    PROCESSOR_WORK_EVENTS.inc(work_type=work.work_type.name)
+                else:
+                    self.dropped[work.work_type] += 1
+            return ok
         with self._lock:
             q = self.queues[work.work_type]
             if len(q) >= self.config.queue_lengths.limit(work.work_type):

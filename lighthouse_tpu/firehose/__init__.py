@@ -1,0 +1,47 @@
+"""Gossip firehose verification engine.
+
+The streaming layer between ``beacon_processor`` and the batched BLS device
+backend (``bls.verify_signature_sets`` / ``bls.tpu_backend``). The reference
+client survives the gossip attestation firehose through machinery this
+package reproduces TPU-first:
+
+  * **adaptive batching** (``batcher.py``) — fixed-shape signature-set
+    batches (padded to the device backend's power-of-two plan shapes) formed
+    under a latency deadline, so a trickle never stalls and a burst
+    amortizes one device dispatch over many sets
+    (``beacon_processor/src/lib.rs`` batch forming, :219-254);
+  * **double-buffered pipeline** (``engine.py``) — host-side work
+    (hash-to-field, signature parse, committee-cache lookups) for batch N+1
+    overlaps device verification of batch N;
+  * **back-pressure + shedding** (``batcher.py``) — a bounded intake with a
+    per-WorkType drop policy mirroring ``beacon_processor/processor.py``
+    (arXiv 2109.11677 flags unbounded verification queues as a DoS surface:
+    back-pressure is a correctness property, not a nicety);
+  * **bisection fallback** (``bisect.py``) — an aggregate batch failure is
+    split-and-retried to isolate the poisoned set(s) in O(bad * log n)
+    device calls instead of n per-set calls;
+  * **attester/shuffling cache tier** (``attester_cache.py``) — committee
+    resolution for gossip attestations off the full-state path
+    (``beacon_chain/src/attester_cache.rs`` / ``shuffling_cache.rs`` parity).
+"""
+
+from .attester_cache import (
+    AttesterCacheTier,
+    ShufflingCache,
+    attester_shuffling_decision_slot,
+)
+from .batcher import AdaptiveBatcher, FirehoseConfig, FirehoseItem
+from .bisect import bisect_verify
+from .engine import FirehoseEngine, FirehoseStats
+
+__all__ = [
+    "AdaptiveBatcher",
+    "AttesterCacheTier",
+    "FirehoseConfig",
+    "FirehoseEngine",
+    "FirehoseItem",
+    "FirehoseStats",
+    "ShufflingCache",
+    "attester_shuffling_decision_slot",
+    "bisect_verify",
+]

@@ -1,0 +1,302 @@
+"""The streaming verification engine: intake -> host prep -> device verify.
+
+Two pipeline threads double-buffer the work:
+
+  * the **prep thread** pulls fixed-shape batches from the
+    ``AdaptiveBatcher`` and runs the host-side stage (committee/cache
+    lookups, signature-set construction — everything before the device
+    dispatch) for batch N+1;
+  * the **device thread** runs batched verification (and bisection fallback
+    on a poisoned batch) for batch N.
+
+The handoff between them is a bounded queue of depth ``prep_depth`` (default
+1): while the device verifies batch N, the host prepares N+1 and then blocks
+— back-pressure propagates to the intake, where the batcher sheds
+lowest-priority work instead of growing without bound. The intake itself
+(``submit``) never blocks, so gossip/network threads stay responsive under
+any device stall.
+
+``synchronous=True`` disables the threads; ``drain()`` runs the pipeline
+inline on the caller's thread (the deterministic test mode, mirroring
+``BeaconProcessor(synchronous=True)``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+from ..beacon_processor.processor import WorkType
+from ..utils.metrics import (
+    FIREHOSE_BATCH_FILL,
+    FIREHOSE_BATCHES_FORMED,
+    FIREHOSE_QUEUE_LATENCY,
+    FIREHOSE_VERIFIED,
+)
+from .batcher import AdaptiveBatcher, FirehoseConfig, FirehoseItem
+from .bisect import bisect_verify
+
+_LATENCY_RESERVOIR = 4096  # most-recent queue latencies kept for percentiles
+
+
+@dataclass
+class FirehoseStats:
+    submitted: int
+    verified: int
+    rejected: int
+    errored: int
+    dropped: int
+    batches_formed: int
+    p50_latency_s: float | None
+    p99_latency_s: float | None
+
+    def as_dict(self) -> dict:
+        return {
+            "submitted": self.submitted,
+            "verified": self.verified,
+            "rejected": self.rejected,
+            "errored": self.errored,
+            "dropped": self.dropped,
+            "batches_formed": self.batches_formed,
+            "p50_latency_s": self.p50_latency_s,
+            "p99_latency_s": self.p99_latency_s,
+        }
+
+
+class FirehoseEngine:
+    """Streaming batch scheduler between the work intake and the BLS device
+    backend.
+
+    ``prepare_fn(payloads) -> list[(group, meta) | Exception]`` is the host
+    stage: one signature-set *group* (list of ``(indices, signing_root,
+    sig_bytes)`` triples) per payload plus opaque ``meta`` handed to the
+    result callback (e.g. the resolved IndexedAttestation), or an Exception
+    marking that payload invalid before any crypto (unknown committee,
+    malformed encoding, ...).
+
+    ``verify_items_fn(flat_items) -> bool`` is the device stage: the batched
+    RLC verifier (``BeaconChain._batch_verify_items`` shape). A poisoned
+    batch is isolated by bisection (``bisect.bisect_verify``), never by
+    per-set fallback.
+    """
+
+    def __init__(
+        self,
+        prepare_fn,
+        verify_items_fn,
+        config: FirehoseConfig | None = None,
+        synchronous: bool = False,
+    ):
+        self.config = config or FirehoseConfig()
+        self.batcher = AdaptiveBatcher(self.config)
+        self.prepare_fn = prepare_fn
+        self.verify_items_fn = verify_items_fn
+        self.synchronous = synchronous
+        # callback(payload, ok, meta) used when submit() gives none
+        self.default_callback = None
+        self.verified = 0
+        self.rejected = 0          # bad signature (bisection-condemned)
+        self.errored = 0           # prep-stage rejections
+        self.batches_formed = 0
+        self._latencies: list[float] = []
+        self._stats_lock = threading.Lock()
+        self._prepared: queue.Queue = queue.Queue(maxsize=self.config.prep_depth)
+        self._threads: list[threading.Thread] = []
+        self._stopping = False
+        if not synchronous:
+            for name, target in (
+                ("firehose-prep", self._prep_loop),
+                ("firehose-device", self._device_loop),
+            ):
+                th = threading.Thread(target=target, daemon=True, name=name)
+                th.start()
+                self._threads.append(th)
+
+    # -- intake -------------------------------------------------------------------
+
+    def submit(
+        self,
+        payload,
+        work_type: WorkType = WorkType.GossipAttestation,
+        callback=None,
+    ) -> bool:
+        """Non-blocking intake. Returns False when the item was shed."""
+        return self.batcher.submit(
+            FirehoseItem(work_type=work_type, payload=payload, callback=callback)
+        )
+
+    # -- pipeline stages ----------------------------------------------------------
+
+    def _prep_batch(self, batch: list[FirehoseItem]):
+        """Host stage: payloads -> signature-set groups (or Exceptions)."""
+        self.batches_formed += 1
+        FIREHOSE_BATCHES_FORMED.inc(work_type=batch[0].work_type.name)
+        FIREHOSE_BATCH_FILL.observe(len(batch))
+        groups = self.prepare_fn([it.payload for it in batch])
+        return batch, groups
+
+    def _verify_batch(self, prepped) -> None:
+        """Device stage: batched verify, bisection on failure, callbacks."""
+        batch, entries = prepped
+        real = [
+            (it, group, meta)
+            for it, entry in zip(batch, entries)
+            if not isinstance(entry, Exception)
+            for group, meta in (entry,)
+            if group
+        ]
+        verdicts: dict[int, bool] = {}
+        device_failed = False
+        if real:
+            # a device fault must not strand the batch without verdicts:
+            # every item still gets its callback, counted as errored
+            try:
+                flat = [item for _, group, _ in real for item in group]
+                if self.verify_items_fn(flat):
+                    for i, _ in enumerate(real):
+                        verdicts[i] = True
+                else:
+                    for i, ok in enumerate(
+                        bisect_verify(
+                            [group for _, group, _ in real],
+                            self.verify_items_fn,
+                            assume_failed=True,
+                        )
+                    ):
+                        verdicts[i] = ok
+            except Exception:  # noqa: BLE001 — device fault fails the batch
+                device_failed = True
+                for i, _ in enumerate(real):
+                    verdicts[i] = False
+        now = time.monotonic()
+        n_ok = n_bad = n_err = 0
+        lats = []
+        ri = 0
+        for it, entry in zip(batch, entries):
+            meta = None
+            if isinstance(entry, Exception) or not entry[0]:
+                ok = False
+                n_err += 1
+                if not isinstance(entry, Exception):
+                    meta = entry[1]
+            else:
+                ok = verdicts[ri]
+                meta = real[ri][2]
+                ri += 1
+                if device_failed:
+                    n_err += 1
+                else:
+                    n_ok += ok
+                    n_bad += not ok
+            lats.append(now - it.enqueued_at)
+            cb = it.callback or self.default_callback
+            if cb is not None:
+                try:
+                    cb(it.payload, ok, meta)
+                except Exception:  # noqa: BLE001 — callbacks never kill the pipe
+                    pass
+        with self._stats_lock:
+            self.verified += n_ok
+            self.rejected += n_bad
+            self.errored += n_err
+            self._latencies.extend(lats)
+            if len(self._latencies) > _LATENCY_RESERVOIR:
+                del self._latencies[: -_LATENCY_RESERVOIR]
+        for v in lats:
+            FIREHOSE_QUEUE_LATENCY.observe(v)
+        FIREHOSE_VERIFIED.inc(n_ok, result="ok")
+        if n_bad:
+            FIREHOSE_VERIFIED.inc(n_bad, result="bad_signature")
+        if n_err:
+            FIREHOSE_VERIFIED.inc(n_err, result="prep_error")
+
+    # -- threaded pipeline --------------------------------------------------------
+
+    def _prep_loop(self) -> None:
+        while True:
+            batch = self.batcher.next_batch()
+            if batch is None:          # batcher closed and drained
+                self._prepared.put(None)
+                return
+            try:
+                prepped = self._prep_batch(batch)
+            except Exception as e:  # noqa: BLE001 — poison batch, keep pumping
+                prepped = (batch, [e] * len(batch))
+            self._prepared.put(prepped)  # blocks at prep_depth: double buffer
+
+    def _device_loop(self) -> None:
+        while True:
+            prepped = self._prepared.get()
+            if prepped is None:
+                return
+            try:
+                self._verify_batch(prepped)
+            except Exception:  # noqa: BLE001 — a device fault drops one batch
+                with self._stats_lock:
+                    self.errored += len(prepped[0])
+
+    # -- synchronous mode / shutdown ---------------------------------------------
+
+    def drain(self) -> int:
+        """Inline pipeline for ``synchronous=True``: form + prep + verify
+        until the intake is empty. Returns batches processed."""
+        n = 0
+        while True:
+            batch = self.batcher.form_now()
+            if batch is None:
+                return n
+            self._verify_batch(self._prep_batch(batch))
+            n += 1
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until everything ACCEPTED so far has a verdict or was
+        evicted (or the timeout expires). Threaded mode only. Gate-rejected
+        submissions never enter ``submitted``, so only post-accept
+        evictions count against it — a batch mid-verify keeps this False
+        until its verdicts land."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._stats_lock:
+                settled = self.verified + self.rejected + self.errored
+            if settled + self.batcher.evicted >= self.batcher.submitted:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self, drain_timeout: float = 30.0) -> None:
+        if self.synchronous:
+            self.drain()
+            return
+        if not self._stopping:
+            self._stopping = True
+            self.batcher.close()
+        for th in self._threads:
+            th.join(timeout=drain_timeout)
+
+    # -- reporting ----------------------------------------------------------------
+
+    def total_dropped(self) -> int:
+        return sum(self.batcher.dropped.values())
+
+    @staticmethod
+    def _percentile(sorted_vals: list[float], q: float) -> float | None:
+        if not sorted_vals:
+            return None
+        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[idx]
+
+    def stats(self) -> FirehoseStats:
+        with self._stats_lock:
+            lats = sorted(self._latencies)
+            return FirehoseStats(
+                submitted=self.batcher.submitted,
+                verified=self.verified,
+                rejected=self.rejected,
+                errored=self.errored,
+                dropped=self.total_dropped(),
+                batches_formed=self.batches_formed,
+                p50_latency_s=self._percentile(lats, 0.50),
+                p99_latency_s=self._percentile(lats, 0.99),
+            )

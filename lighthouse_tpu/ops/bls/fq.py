@@ -311,9 +311,12 @@ def _conv_product_digits(a, b):
     TPUs have no fast 64-bit integer multiply (u64 lowers to multi-op u32
     emulation on the VPU) and f64 is software-emulated, but f32 FMA runs at
     full VPU rate. Digits are <= 318 (for 2^22-bounded limbs) so every conv
-    accumulator is <= 51 * 318^2 < 2^23 — exact in f32. The recombined limb
-    accumulators are < 2^31.4, a TIGHTER bound than the f64 path's 2^48.6,
-    which shortens the fold schedule downstream."""
+    accumulator is <= 51 * 318^2 < 2^23 — exact in f32. Recombined limb
+    accumulators are < 2^30.4 pre-spill; limb 49 then absorbs the
+    2^16-scaled spill of digit position 100 (see end of function), raising
+    its bound to ~2^32.6 — still far tighter than the f64 path's 2^48.6,
+    which shortens the fold schedule downstream (the fold walk uses the
+    exact per-limb bounds from conv_limb_bounds, not these summaries)."""
     da = _to_digits_f32(a)
     db = _to_digits_f32(b)
     nb = [(0, 0)] * (a.ndim - 1)

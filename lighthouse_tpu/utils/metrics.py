@@ -196,6 +196,40 @@ PROCESSOR_QUEUE_LENGTH = REGISTRY.gauge(
     "Current per-work-type queue length",
     label_names=("work_type",),
 )
+FIREHOSE_INTAKE_DEPTH = REGISTRY.gauge(
+    "firehose_intake_depth",
+    "Buffered items per work type in the firehose intake",
+    label_names=("work_type",),
+)
+FIREHOSE_DROPPED = REGISTRY.counter(
+    "firehose_dropped_total",
+    "Items shed by firehose back-pressure, per work type",
+    label_names=("work_type",),
+)
+FIREHOSE_BATCHES_FORMED = REGISTRY.counter(
+    "firehose_batches_formed_total",
+    "Device batches formed by the adaptive batcher",
+    label_names=("work_type",),
+)
+FIREHOSE_BATCH_FILL = REGISTRY.histogram(
+    "firehose_batch_fill",
+    "Items per formed firehose batch (pre-padding)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+)
+FIREHOSE_QUEUE_LATENCY = REGISTRY.histogram(
+    "firehose_queue_latency_seconds",
+    "Intake-to-verdict latency through the firehose pipeline",
+)
+FIREHOSE_VERIFIED = REGISTRY.counter(
+    "firehose_items_total",
+    "Firehose verification outcomes (ok / bad_signature / prep_error)",
+    label_names=("result",),
+)
+FIREHOSE_SHUFFLING_CACHE = REGISTRY.counter(
+    "firehose_shuffling_cache_total",
+    "Attester/shuffling cache tier lookups (hit / miss)",
+    label_names=("result",),
+)
 SLASHER_CHUNKS_UPDATED = REGISTRY.counter(
     "slasher_chunks_updated_total",
     "Slasher target-array rows updated (slasher/src/metrics.rs)",

@@ -10,11 +10,14 @@ decompressed on device — including the poisoning fallback.
 import numpy as np
 import pytest
 
+
 from lighthouse_tpu import bls
 from lighthouse_tpu.beacon_chain.chain import AttestationError, BeaconChain
 from lighthouse_tpu.testing.harness import StateHarness
 from lighthouse_tpu.types.spec import minimal_spec
 from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+pytestmark = pytest.mark.kernel  # JAX compile-heavy tier (see pytest.ini)
 
 
 @pytest.fixture(scope="module")

@@ -16,7 +16,26 @@ import lighthouse_tpu  # noqa: F401  (enables x64)
 from lighthouse_tpu.ops.bls import fq, tower as tw
 from lighthouse_tpu.ops.bls_oracle import fields as of
 
+pytestmark = pytest.mark.kernel
+
 rng = random.Random(0xF1E1D)
+
+
+@pytest.fixture(
+    autouse=True, params=["f64", "digits"], ids=["conv-f64", "conv-digits"]
+)
+def conv_impl(request, monkeypatch):
+    """Run every fq/plans kernel-parity test under BOTH convolution
+    backends: the CPU default (f64 FMA chain) AND the TPU default (f32
+    digit split) — the consensus-critical TPU path must be validated on
+    every CPU CI run, not only when a TPU window opens (ADVICE r5).
+    conv_backend() is consulted at trace time and each test constructs
+    fresh jit wrappers, so resetting the cached choice is sufficient."""
+    monkeypatch.setenv("LIGHTHOUSE_CONV_IMPL", request.param)
+    old = fq._CONV_IMPL
+    fq._CONV_IMPL = None
+    yield request.param
+    fq._CONV_IMPL = old
 
 
 def rint():

@@ -8,10 +8,13 @@ tests.rs:449) plus the vectorized byte codecs.
 import numpy as np
 import pytest
 
+
 import lighthouse_tpu  # noqa: F401
 from lighthouse_tpu import bls
 from lighthouse_tpu.bls import serde
 from lighthouse_tpu.ops.bls_oracle import curves as oc
+
+pytestmark = pytest.mark.kernel  # JAX compile-heavy tier (see pytest.ini)
 
 
 def _keypair(i: int):

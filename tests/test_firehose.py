@@ -1,0 +1,470 @@
+"""Firehose subsystem: bisection isolation, back-pressure/shedding, the
+double-buffered pipeline, and the attester/shuffling cache tier.
+
+The cache-tier parity test pins the core safety property: committees (and
+signing roots) resolved through the cache tier are byte-identical to the
+full-state path, including across an epoch boundary. Chain-level tests run
+on the native C++ backend (real crypto at CPU speed, no device compiles).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu import bls
+from lighthouse_tpu.beacon_chain.chain import BeaconChain
+from lighthouse_tpu.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    Work,
+    WorkType,
+)
+from lighthouse_tpu.firehose import (
+    AdaptiveBatcher,
+    FirehoseConfig,
+    FirehoseEngine,
+    FirehoseItem,
+    bisect_verify,
+)
+from lighthouse_tpu.testing import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+# -- bisection ---------------------------------------------------------------------
+
+
+class CountingVerifier:
+    """Batched fake verifier: items are ('id',) tuples; ids in `bad` fail."""
+
+    def __init__(self, bad):
+        self.bad = set(bad)
+        self.calls = []
+
+    def __call__(self, items):
+        self.calls.append(len(items))
+        return not any(it[0] in self.bad for it in items)
+
+
+class TestBisect:
+    def test_isolates_exactly_the_poisoned_sets(self):
+        bad = {3, 11, 12}
+        groups = [[(i,)] for i in range(16)]
+        vf = CountingVerifier(bad)
+        verdicts = bisect_verify(groups, vf, assume_failed=True)
+        assert verdicts == [i not in bad for i in range(16)]
+
+    def test_single_poison_is_logarithmic(self):
+        # one bad set in a 64-batch: O(log n) calls, not 64 per-set verifies
+        groups = [[(i,)] for i in range(64)]
+        vf = CountingVerifier({37})
+        verdicts = bisect_verify(groups, vf, assume_failed=True)
+        assert verdicts == [i != 37 for i in range(64)]
+        assert len(vf.calls) <= 2 * 6 + 1  # 2 calls per level, log2(64)=6
+
+    def test_group_fails_as_a_unit(self):
+        # three-item groups (the aggregate shape): one bad item condemns
+        # exactly its own group
+        groups = [[(3 * g,), (3 * g + 1,), (3 * g + 2,)] for g in range(8)]
+        vf = CountingVerifier({10})  # lives in group 3
+        verdicts = bisect_verify(groups, vf, assume_failed=True)
+        assert verdicts == [g != 3 for g in range(8)]
+
+    def test_all_good_without_assume_failed(self):
+        vf = CountingVerifier(set())
+        assert bisect_verify([[(1,)], [(2,)]], vf) == [True, True]
+        assert vf.calls == [2]  # one batched call, no splitting
+
+    def test_empty(self):
+        assert bisect_verify([], CountingVerifier(set())) == []
+
+
+# -- back-pressure / shedding ------------------------------------------------------
+
+
+class TestBackPressure:
+    def test_drops_lowest_priority_first(self):
+        b = AdaptiveBatcher(FirehoseConfig(intake_capacity=4))
+        # fill with the LOWEST-priority batchable work (GossipAttestation=6)
+        for i in range(4):
+            assert b.submit(FirehoseItem(WorkType.GossipAttestation, i))
+        # a higher-priority aggregate (5) evicts one attestation
+        assert b.submit(FirehoseItem(WorkType.GossipAggregate, "agg"))
+        assert b.depth(WorkType.GossipAggregate) == 1
+        assert b.depth(WorkType.GossipAttestation) == 3
+        assert b.dropped.get(WorkType.GossipAttestation) == 1
+        # an arrival that is itself lowest-priority is the one shed
+        assert not b.submit(FirehoseItem(WorkType.GossipAttestation, "late"))
+        assert b.dropped[WorkType.GossipAttestation] == 2
+        assert b.depth() == 4
+
+    def test_per_type_cap(self):
+        b = AdaptiveBatcher(
+            FirehoseConfig(
+                intake_capacity=100,
+                per_type_capacity={WorkType.GossipAttestation: 2},
+            )
+        )
+        ok = [
+            b.submit(FirehoseItem(WorkType.GossipAttestation, i))
+            for i in range(5)
+        ]
+        assert ok == [True, True, False, False, False]
+        assert b.dropped[WorkType.GossipAttestation] == 3
+
+    def test_intake_never_blocks_while_device_stalls(self):
+        """submit() must stay non-blocking while the verify stage is wedged:
+        the prep thread blocks on the handoff, the intake sheds."""
+        release = threading.Event()
+
+        def stalled_verify(items):
+            release.wait(timeout=10.0)
+            return True
+
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], None) for p in ps],
+            verify_items_fn=stalled_verify,
+            config=FirehoseConfig(
+                max_batch=4, deadline_s=0.001, intake_capacity=16
+            ),
+        )
+        try:
+            t0 = time.monotonic()
+            n = 2000
+            accepted = sum(engine.submit(i) for i in range(n))
+            elapsed = time.monotonic() - t0
+            # 2000 non-blocking submits against a wedged device: the whole
+            # pump must finish far inside the stall (generous CI bound)
+            assert elapsed < 2.0, f"intake blocked for {elapsed:.2f}s"
+            assert accepted < n  # back-pressure shed the overflow
+            assert engine.total_dropped() == n - accepted
+        finally:
+            release.set()
+            engine.stop(drain_timeout=10.0)
+        st = engine.stats()
+        # everything accepted eventually got a verdict after the stall
+        assert st.verified == accepted
+
+
+# -- adaptive batching -------------------------------------------------------------
+
+
+class TestAdaptiveBatcher:
+    def test_full_batch_returns_immediately(self):
+        b = AdaptiveBatcher(FirehoseConfig(max_batch=4, deadline_s=5.0))
+        for i in range(4):
+            b.submit(FirehoseItem(WorkType.GossipAttestation, i))
+        t0 = time.monotonic()
+        batch = b.next_batch(timeout=1.0)
+        assert batch is not None and len(batch) == 4
+        assert time.monotonic() - t0 < 1.0  # no deadline wait for a full batch
+
+    def test_trickle_flushes_at_deadline(self):
+        b = AdaptiveBatcher(FirehoseConfig(max_batch=64, deadline_s=0.05))
+        b.submit(FirehoseItem(WorkType.GossipAttestation, "only"))
+        t0 = time.monotonic()
+        batch = b.next_batch(timeout=2.0)
+        dt = time.monotonic() - t0
+        assert batch is not None and len(batch) == 1
+        assert dt < 1.0  # flushed by the deadline, not the timeout
+
+    def test_priority_order_across_types(self):
+        b = AdaptiveBatcher(FirehoseConfig(max_batch=8))
+        b.submit(FirehoseItem(WorkType.GossipAttestation, "att"))
+        b.submit(FirehoseItem(WorkType.GossipAggregate, "agg"))
+        first = b.form_now()
+        assert [it.payload for it in first] == ["agg"]  # aggregates first
+        second = b.form_now()
+        assert [it.payload for it in second] == ["att"]
+
+    def test_batches_are_homogeneous(self):
+        b = AdaptiveBatcher(FirehoseConfig(max_batch=8))
+        for i in range(3):
+            b.submit(FirehoseItem(WorkType.GossipAttestation, i))
+        for i in range(2):
+            b.submit(FirehoseItem(WorkType.GossipAggregate, i))
+        batch = b.form_now()
+        assert len({it.work_type for it in batch}) == 1
+
+
+# -- pipeline ----------------------------------------------------------------------
+
+
+class TestEnginePipeline:
+    def test_synchronous_drain_verdicts_and_stats(self):
+        bad = {5, 9}
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [
+                ValueError("boom") if p == 7 else ([(p,)], f"meta{p}")
+                for p in ps
+            ],
+            verify_items_fn=lambda items: not any(
+                it[0] in bad for it in items
+            ),
+            config=FirehoseConfig(max_batch=4),
+            synchronous=True,
+        )
+        verdicts = {}
+        for i in range(12):
+            engine.submit(i, callback=lambda p, ok, meta: verdicts.setdefault(p, (ok, meta)))
+        engine.drain()
+        st = engine.stats()
+        assert st.verified == 9 and st.rejected == 2 and st.errored == 1
+        assert verdicts[5] == (False, "meta5")
+        assert verdicts[7] == (False, None)  # prep error
+        assert verdicts[2] == (True, "meta2")
+        assert st.batches_formed == 3
+        assert st.p50_latency_s is not None and st.p99_latency_s is not None
+
+    def test_device_fault_still_delivers_verdicts(self):
+        """A verify-stage exception must not strand the batch: every item
+        still gets its callback (ok=False) and counts as errored."""
+
+        def exploding_verify(items):
+            raise RuntimeError("device fell over")
+
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], None) for p in ps],
+            verify_items_fn=exploding_verify,
+            config=FirehoseConfig(max_batch=4),
+            synchronous=True,
+        )
+        verdicts = {}
+        for i in range(4):
+            engine.submit(i, callback=lambda p, ok, m: verdicts.__setitem__(p, ok))
+        engine.drain()
+        assert verdicts == {0: False, 1: False, 2: False, 3: False}
+        st = engine.stats()
+        assert st.errored == 4 and st.verified == 0 and st.rejected == 0
+
+    def test_double_buffering_overlaps_prep_and_verify(self):
+        """While the device verifies batch N, the prep thread must already
+        be preparing batch N+1 (the handoff queue buffers one batch)."""
+        events = []
+        lock = threading.Lock()
+
+        def prepare(ps):
+            with lock:
+                events.append(("prep_start", time.monotonic()))
+            time.sleep(0.05)
+            with lock:
+                events.append(("prep_end", time.monotonic()))
+            return [([(p,)], None) for p in ps]
+
+        def verify(items):
+            with lock:
+                events.append(("verify_start", time.monotonic()))
+            time.sleep(0.05)
+            with lock:
+                events.append(("verify_end", time.monotonic()))
+            return True
+
+        engine = FirehoseEngine(
+            prepare_fn=prepare,
+            verify_items_fn=verify,
+            config=FirehoseConfig(max_batch=4, deadline_s=0.001),
+        )
+        for i in range(12):  # 3 batches of 4
+            engine.submit(i)
+        engine.stop(drain_timeout=15.0)
+        assert engine.stats().verified == 12
+        with lock:
+            seq = list(events)
+        # overlap: some prep interval must intersect some verify interval
+        preps = list(zip(
+            [t for n, t in seq if n == "prep_start"],
+            [t for n, t in seq if n == "prep_end"],
+        ))
+        verifies = list(zip(
+            [t for n, t in seq if n == "verify_start"],
+            [t for n, t in seq if n == "verify_end"],
+        ))
+        overlapped = any(
+            ps < ve and vs < pe
+            for ps, pe in preps
+            for vs, ve in verifies
+        )
+        assert overlapped, f"no prep/verify overlap observed: {seq}"
+
+
+# -- beacon_processor routing ------------------------------------------------------
+
+
+class TestProcessorRouting:
+    def test_unhandled_gossip_attestations_route_to_firehose(self):
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], None) for p in ps],
+            verify_items_fn=lambda items: True,
+            config=FirehoseConfig(max_batch=8),
+            synchronous=True,
+        )
+        p = BeaconProcessor(
+            BeaconProcessorConfig(), synchronous=False, firehose=engine
+        )
+        p.shutdown()
+        assert p.submit(Work(WorkType.GossipAttestation, "a1"))
+        assert p.submit(Work(WorkType.GossipAggregate, "g1"))
+        # handled work still takes the generic queues
+        hits = []
+        p.submit(
+            Work(WorkType.GossipAttestation, "handled",
+                 process_individual=hits.append)
+        )
+        assert engine.batcher.depth() == 2
+        assert p.queue_len(WorkType.GossipAttestation) == 1
+        engine.drain()
+        assert engine.stats().verified == 2
+        p.run_until_idle()
+        assert hits == ["handled"]
+
+    def test_firehose_shed_counts_as_processor_drop(self):
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], None) for p in ps],
+            verify_items_fn=lambda items: True,
+            config=FirehoseConfig(
+                intake_capacity=2,
+                per_type_capacity={WorkType.GossipAttestation: 2},
+            ),
+            synchronous=True,
+        )
+        p = BeaconProcessor(
+            BeaconProcessorConfig(), synchronous=False, firehose=engine
+        )
+        p.shutdown()
+        ok = [
+            p.submit(Work(WorkType.GossipAttestation, i)) for i in range(4)
+        ]
+        assert ok == [True, True, False, False]
+        assert p.dropped[WorkType.GossipAttestation] == 2
+
+
+# -- attester-cache tier vs the full-state path ------------------------------------
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    # native C++ backend: real crypto at CPU speed for consensus-logic tests
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture(scope="module")
+def chain_two_epochs():
+    """A chain extended across an epoch boundary (minimal preset: 8-slot
+    epochs), blocks imported through the real pipeline."""
+    spec = minimal_spec()
+    h = StateHarness(spec, n_validators=32)
+    clock = ManualSlotClock(0)
+    chain = BeaconChain(spec, h.state.copy(), slot_clock=clock)
+    for slot in range(1, 12):  # crosses the epoch-1 boundary at slot 8
+        clock.set_slot(slot)
+        block = h.produce_block(slot)
+        h.apply_block(block)
+        chain.process_block(block)
+    return spec, h, chain, clock
+
+
+class TestAttesterCacheTier:
+    def _attestations(self, spec, h, chain, slot):
+        head_root = chain.head.root
+        return h.unaggregated_attestations_for_slot(
+            chain.head.state, slot, head_root
+        )
+
+    def test_cache_matches_full_state_across_epoch_boundary(
+        self, chain_two_epochs
+    ):
+        spec, h, chain, clock = chain_two_epochs
+        from lighthouse_tpu.state_transition import get_beacon_committee
+
+        checked = 0
+        for slot in (7, 8, 11):  # last slot of epoch 0, first + later of 1
+            atts = self._attestations(spec, h, chain, slot)
+            assert atts
+            for att in atts[: min(6, len(atts))]:
+                via_cache = chain.attester_cache.committee_for(att.data)
+                state = chain._attestation_state(att)
+                via_state = get_beacon_committee(
+                    spec, state, int(att.data.slot), int(att.data.index)
+                )
+                assert via_cache is not None
+                assert np.array_equal(
+                    np.asarray(via_cache), np.asarray(via_state)
+                ), f"slot {slot}: cache committee != full-state committee"
+                checked += 1
+        assert checked >= 6
+        assert chain.attester_cache.shuffling.hits > 0  # the tier actually hit
+
+    def test_signing_roots_match_state_domain(self, chain_two_epochs):
+        spec, h, chain, clock = chain_two_epochs
+        for slot in (7, 11):
+            att = self._attestations(spec, h, chain, slot)[0]
+            indexed = chain._indexed_attestation_fast(att)
+            state = chain._attestation_state(att)
+            fast = chain._attester_item_fast(indexed)
+            slow = chain._attester_item(state, indexed)
+            assert fast == slow
+
+    def test_verify_path_uses_cache_and_accepts(self, chain_two_epochs):
+        spec, h, chain, clock = chain_two_epochs
+        atts = self._attestations(spec, h, chain, int(chain.head.slot))
+        results = chain.verify_unaggregated_attestations(atts)
+        assert all(not isinstance(r[1], Exception) for r in results)
+
+    def test_poisoned_batch_bisects_to_exact_culprits(self, chain_two_epochs):
+        spec, h, chain, clock = chain_two_epochs
+        atts = self._attestations(spec, h, chain, int(chain.head.slot))
+        assert len(atts) >= 4
+        atts[0].signature = atts[2].signature
+        atts[3].signature = atts[2].signature
+        results = chain.verify_unaggregated_attestations(atts)
+        errs = [i for i, r in enumerate(results) if isinstance(r[1], Exception)]
+        assert errs == [0, 3]
+
+    def test_unknown_block_root_is_prep_error(self, chain_two_epochs):
+        spec, h, chain, clock = chain_two_epochs
+        atts = self._attestations(spec, h, chain, int(chain.head.slot))
+        att = atts[0]
+        att.data.beacon_block_root = b"\xee" * 32
+        results = chain.verify_unaggregated_attestations([att])
+        assert isinstance(results[0][1], Exception)
+
+
+class TestChainFirehose:
+    def test_end_to_end_stream_applies_to_pool(self, chain_two_epochs):
+        spec, h, chain, clock = chain_two_epochs
+        engine = chain.create_firehose(
+            config=FirehoseConfig(max_batch=8, deadline_s=0.005),
+            synchronous=True,
+        )
+        atts = h.unaggregated_attestations_for_slot(
+            chain.head.state, int(chain.head.slot), chain.head.root
+        )
+        for att in atts:
+            assert engine.submit(att)
+        engine.drain()
+        st = engine.stats()
+        assert st.verified == len(atts)
+        assert st.rejected == 0 and st.errored == 0
+        assert st.batches_formed >= 1
+
+    def test_aggregates_stream_through_same_engine(self, chain_two_epochs):
+        spec, h, chain, clock = chain_two_epochs
+        engine = chain.create_firehose(
+            config=FirehoseConfig(max_batch=4), synchronous=True
+        )
+        saps = h.signed_aggregate_and_proofs(
+            chain.head.state, int(chain.head.slot), chain.head.root
+        )
+        assert saps
+        for sap in saps:
+            assert engine.submit(sap, work_type=WorkType.GossipAggregate)
+        engine.drain()
+        st = engine.stats()
+        assert st.verified == len(saps)
+        assert st.rejected == 0 and st.errored == 0

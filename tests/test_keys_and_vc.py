@@ -11,6 +11,13 @@ from lighthouse_tpu.keys import (
 from lighthouse_tpu.validator_client import NotSafe, SlashingDatabase, ValidatorStore
 from lighthouse_tpu.types.containers import AttestationData, Checkpoint
 from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.keys import keystore as _keystore
+
+# AES keystore paths need the gated 'cryptography' package (keystore.py)
+requires_aes = pytest.mark.skipif(
+    not _keystore._HAVE_CRYPTOGRAPHY,
+    reason="cryptography package unavailable (AES-128-CTR keystore paths)",
+)
 
 
 class TestDerivation:
@@ -34,6 +41,7 @@ class TestDerivation:
             derive_sk_from_path(seed, "x/12381")
 
 
+@requires_aes
 class TestKeystore:
     def test_encrypt_decrypt_roundtrip(self):
         secret = bytes(range(32))
@@ -92,6 +100,7 @@ class TestKeystore:
         )
 
 
+@requires_aes
 class TestWallet:
     def test_wallet_derives_consistent_validators(self):
         w = Wallet.create("w", "pw", seed=b"\x02" * 32)

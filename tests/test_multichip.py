@@ -10,10 +10,13 @@ one poisoned set fails the whole batch.
 import numpy as np
 import pytest
 
+
 import jax
 
 from lighthouse_tpu.bls.tpu_backend import verify_signature_sets_sharded
 from lighthouse_tpu.ops.bls import g2
+
+pytestmark = pytest.mark.kernel  # JAX compile-heavy tier (see pytest.ini)
 
 
 @pytest.fixture(scope="module")

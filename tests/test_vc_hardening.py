@@ -165,6 +165,12 @@ def test_web3signer_remote_signing_roundtrip(bn):
         signer.stop()
 
 
+@pytest.mark.skipif(
+    not __import__(
+        "lighthouse_tpu.keys.keystore", fromlist=["_HAVE_CRYPTOGRAPHY"]
+    )._HAVE_CRYPTOGRAPHY,
+    reason="cryptography package unavailable (AES-128-CTR keystore paths)",
+)
 def test_keymanager_crud(tmp_path):
     from lighthouse_tpu.keys.keystore import Keystore
 

@@ -42,6 +42,7 @@ CACHE = os.path.join(ROOT, ".bench_cache")
 LOG = os.path.join(ROOT, "TPU_WINDOW_LOG.jsonl")
 STATE = os.path.join(CACHE, "hunter_state.json")
 RECORD = os.path.join(CACHE, "tpu_record.json")
+RECORD_FIREHOSE = os.path.join(CACHE, "tpu_firehose_record.json")
 RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
@@ -49,10 +50,18 @@ PROBE_TIMEOUT_S = float(os.environ.get("HUNTER_PROBE_TIMEOUT", "120"))
 
 # bench._LADDER reversed: smallest first — land ANY TPU record, then climb.
 # Timeouts get +50% slack over bench's (a window may open mid-compile).
+# The firehose streaming rung (BASELINE.json config #5) slots in right after
+# the smallest headline rung: one TPU window can capture BOTH metrics.
 RUNGS = [
-    (sets, keys, validators, batch, timeout * 1.5)
+    (sets, keys, validators, batch, timeout * 1.5, "sets")
     for sets, keys, validators, batch, timeout in reversed(bench._LADDER)
 ]
+RUNGS.insert(
+    1,
+    bench._FIREHOSE_RUNG[:4]
+    + (bench._FIREHOSE_RUNG[4] * 1.5,)
+    + bench._FIREHOSE_RUNG[5:],
+)
 
 
 def log(event: str, **kw) -> None:
@@ -101,11 +110,12 @@ def save_state(st: dict) -> None:
 def run_rung(rung_idx: int) -> dict | None:
     """Run one ladder rung via bench.run_inner (shared subprocess runner,
     serialized against a concurrent bench.py by the cross-process lock)."""
-    sets, keys, validators, batch, timeout = RUNGS[rung_idx]
-    log("bench_start", rung=rung_idx, sets=sets, keys=keys, batch=batch)
+    sets, keys, validators, batch, timeout, mode = RUNGS[rung_idx]
+    log("bench_start", rung=rung_idx, sets=sets, keys=keys, batch=batch,
+        mode=mode)
     t0 = time.perf_counter()
     rec, note = bench.run_inner(
-        sets, keys, validators, batch, timeout, fallback=False
+        sets, keys, validators, batch, timeout, fallback=False, mode=mode
     )
     dt = time.perf_counter() - t0
     if rec is None:
@@ -124,10 +134,16 @@ def persist(rec: dict, rung_idx: int) -> None:
     os.makedirs(CACHE, exist_ok=True)
     with open(RECORDS, "a") as f:
         f.write(json.dumps(rec) + "\n")
-    # best = largest rung; ties by throughput
+    # firehose records live in their own best-record file (different metric;
+    # bench.py --firehose emits it when the end-of-round tunnel is wedged)
+    record_path = (
+        RECORD_FIREHOSE
+        if rec.get("metric") == "firehose_attestations_verified_per_s"
+        else RECORD
+    )
     best = None
     try:
-        with open(RECORD) as f:
+        with open(record_path) as f:
             best = json.load(f)
     except (OSError, ValueError):
         pass
@@ -135,7 +151,7 @@ def persist(rec: dict, rung_idx: int) -> None:
     # must replace an old-commit record even if the old one was faster —
     # the record reports HEAD's performance, not the round's best-ever)
     if best is None or rung_idx >= best.get("_rung", -1):
-        bench.atomic_write_json(RECORD, dict(rec, _rung=rung_idx))
+        bench.atomic_write_json(record_path, dict(rec, _rung=rung_idx))
 
 
 def main() -> None:
@@ -155,6 +171,11 @@ def main() -> None:
             elif platform == "tpu":
                 # a window is open: climb rungs until one fails or all done
                 while st["next_rung"] < len(RUNGS):
+                    if bench.bench_main_in_progress():
+                        # a bench.py probe+ladder phase owns the device:
+                        # starting a rung now would corrupt its measurement
+                        log("rung_skipped_bench_in_progress")
+                        break
                     rec = run_rung(st["next_rung"])
                     if rec is None:
                         key = str(st["next_rung"])
@@ -169,7 +190,9 @@ def main() -> None:
                     persist(rec, st["next_rung"])
                     st["next_rung"] += 1
                     save_state(st)
-                if st["next_rung"] >= len(RUNGS):
+                if st["next_rung"] >= len(RUNGS) and not (
+                    bench.bench_main_in_progress()
+                ):
                     # all rungs conquered with current kernels; re-run the
                     # top rung occasionally in case kernels improved
                     rec = run_rung(len(RUNGS) - 1)
