@@ -682,6 +682,162 @@ def _inner_firehose():
     )
 
 
+def _build_epoch_state(spec, n: int, rng):
+    """Synthetic mainnet-preset altair state with ``n`` validators for the
+    epoch-replay rung (BASELINE config #4). Dummy pubkeys: epoch processing
+    never reads them (the bench epoch avoids the sync-committee rotation
+    boundary, like any non-boundary mainnet epoch)."""
+    from lighthouse_tpu.types.containers import Checkpoint, Validator, for_preset
+    from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH
+
+    ns = for_preset(spec.preset.name)
+    p = spec.preset
+    state = ns.BeaconStateAltair()
+    # epoch 101: (102 % EPOCHS_PER_ETH1_VOTING_PERIOD=64) != 0 and
+    # (102 % EPOCHS_PER_SYNC_COMMITTEE_PERIOD=256) != 0 — no host-side
+    # eth1/sync/historical boundary work pollutes the validator-axis number
+    cur_epoch = 101
+    state.slot = (cur_epoch + 1) * p.SLOTS_PER_EPOCH - 1
+    pk = b"\x00" * 48
+    wc = b"\x00" * 32
+    far = FAR_FUTURE_EPOCH
+    eff = np.full(n, 32 * 10**9, dtype=np.uint64)
+    # a realistic trickle of ejectable validators (a storm would make the
+    # numpy baseline quadratic in initiate_validator_exit's registry scans
+    # — real epochs eject at most a handful)
+    eff[rng.choice(n, size=min(32, n // 64), replace=False)] = 15 * 10**9
+    validators = []
+    for i in range(n):
+        validators.append(
+            Validator(
+                pubkey=pk,
+                withdrawal_credentials=wc,
+                effective_balance=int(eff[i]),
+                slashed=False,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=far,
+                withdrawable_epoch=far,
+            )
+        )
+    state.validators = validators
+    state.balances = rng.integers(
+        31 * 10**9, 33 * 10**9, n, dtype=np.int64
+    ).astype(np.uint64)
+    state.previous_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    state.current_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    state.inactivity_scores = np.zeros(n, dtype=np.uint64)
+    for i in range(min(p.SLOTS_PER_HISTORICAL_ROOT, state.slot)):
+        state.block_roots[i] = rng.bytes(32)
+    state.finalized_checkpoint = Checkpoint(epoch=cur_epoch - 2, root=rng.bytes(32))
+    state.previous_justified_checkpoint = Checkpoint(
+        epoch=cur_epoch - 2, root=rng.bytes(32)
+    )
+    state.current_justified_checkpoint = Checkpoint(
+        epoch=cur_epoch - 1, root=rng.bytes(32)
+    )
+    state.justification_bits = np.array([1, 1, 1, 1], dtype=bool)
+    return state
+
+
+def _inner_epoch():
+    """Epoch-engine rung (BASELINE.json config #4, the 1M-validator epoch
+    replay): advance a synthetic mainnet-shape altair state across epoch
+    boundaries through the DEVICE epoch engine (lighthouse_tpu/epoch_engine)
+    and report validators/sec, ms/epoch and the host<->device delta-update
+    traffic. The numpy per_epoch.py path at the same shape is the baseline
+    (skipped at the million-validator rung, where the object gather alone
+    takes minutes — the engine existing is the point)."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    if fallback:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from lighthouse_tpu import epoch_engine
+    from lighthouse_tpu.state_transition.per_epoch import process_epoch
+    from lighthouse_tpu.types.spec import mainnet_spec
+
+    n = N_VALIDATORS
+    iters = int(os.environ.get("BENCH_EPOCH_ITERS", "3"))
+    platform = jax.devices()[0].platform
+    spec = mainnet_spec(altair_fork_epoch=0)
+    rng = np.random.default_rng(0xE9_0C)
+    t0 = time.perf_counter()
+    state = _build_epoch_state(spec, n, rng)
+    print(f"# built {n}-validator state in {time.perf_counter() - t0:.0f}s",
+          flush=True)
+
+    epoch_engine.set_backend("device")
+    per_epoch_slots = spec.preset.SLOTS_PER_EPOCH
+
+    def one_epoch(s):
+        assert epoch_engine.maybe_process_epoch_on_device(spec, s), (
+            "epoch engine refused the bench state"
+        )
+        s.slot += per_epoch_slots
+        # keep participation live so every epoch does real reward work
+        s.current_epoch_participation = rng.integers(0, 8, len(s.validators)).astype(
+            np.uint8
+        )
+
+    t0 = time.perf_counter()
+    one_epoch(state)  # bind mirror + compile
+    print(
+        f"# warmup (bind + compile) {time.perf_counter() - t0:.0f}s on "
+        f"{platform}",
+        flush=True,
+    )
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        one_epoch(state)
+    dt = time.perf_counter() - t0
+    stats = epoch_engine.engine_stats(state) or {}
+
+    # numpy baseline at the same shape (one epoch; prohibitive at 1M)
+    numpy_v_per_s = None
+    if n <= 262144:
+        epoch_engine.set_backend("numpy")
+        twin = _build_epoch_state(spec, n, np.random.default_rng(0xE9_0C))
+        t0 = time.perf_counter()
+        process_epoch(spec, twin)
+        numpy_dt = time.perf_counter() - t0
+        numpy_v_per_s = n / numpy_dt if numpy_dt else None
+
+    ms_per_epoch = dt / iters * 1e3
+    value = n * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "epoch_validators_per_s",
+                "value": round(value, 2),
+                "unit": "validators/s",
+                "vs_baseline": (
+                    round(value / numpy_v_per_s, 3) if numpy_v_per_s else None
+                ),
+                "platform": platform,
+                "fallback": fallback,
+                "shape": {
+                    "validators": n,
+                    "preset": "mainnet",
+                    "fork": "altair",
+                    "epochs_timed": iters,
+                },
+                "ms_per_epoch": round(ms_per_epoch, 2),
+                "numpy_validators_per_s": (
+                    round(numpy_v_per_s, 2) if numpy_v_per_s else None
+                ),
+                "host_to_device_bytes_per_epoch": (
+                    stats.get("last_host_to_device_bytes")
+                ),
+                "mirror": stats,
+            }
+        )
+    )
+
+
 # Shape ladder: (sets, keys, validators, batch, timeout_s). The first entry
 # is the mainnet shape (BASELINE.json config #4); smaller rungs bound a
 # pathological device compile (observed: the tunnel's server-side compile of
@@ -697,6 +853,18 @@ _LADDER = [
 # batch, timeout_s, mode). keys=1 is the gossip unaggregated shape; the
 # stream rate/duration come from BENCH_FIREHOSE_* env (default 50k att/s).
 _FIREHOSE_RUNG = (256, 1, 4096, 16, 1800.0, "firehose")
+
+# Epoch-engine ladder (BASELINE.json config #4): (validators, timeout_s).
+# Largest first for bench main (like _LADDER); the hunter climbs smallest
+# first. Only the validator count matters — sets/keys/batch are unused by
+# the epoch measurement and passed as 0 through run_inner's env plumbing.
+_EPOCH_LADDER = [
+    (1048576, 2700.0),
+    (262144, 1500.0),
+    (32768, 900.0),
+]
+_EPOCH_RUNG_SMALL = (0, 0, 32768, 0, 1350.0, "epoch")
+_EPOCH_RUNG_FULL = (0, 0, 1048576, 0, 4050.0, "epoch")
 
 
 def git_head() -> str:
@@ -721,7 +889,10 @@ def _hunter_record(mode: str = "sets") -> dict | None:
     probe fails is honest — the record carries captured_at + window_hunter
     markers, the commit it measured (flagged stale if != HEAD), and the
     probe-log tail proving the window hunt."""
-    name = "tpu_firehose_record.json" if mode == "firehose" else "tpu_record.json"
+    name = {
+        "firehose": "tpu_firehose_record.json",
+        "epoch": "tpu_epoch_record.json",
+    }.get(mode, "tpu_record.json")
     path = os.path.join(_CACHE_DIR, name)
     try:
         with open(path) as f:
@@ -780,10 +951,17 @@ def _emit_hunter_record(
 
 
 def main():
-    mode = "firehose" if "--firehose" in sys.argv else "sets"
+    mode = "sets"
+    if "--firehose" in sys.argv:
+        mode = "firehose"
+    elif "--epoch" in sys.argv:
+        mode = "epoch"
     if "--inner" in sys.argv:
-        if os.environ.get("BENCH_MODE", mode) == "firehose":
+        inner_mode = os.environ.get("BENCH_MODE", mode)
+        if inner_mode == "firehose":
             _inner_firehose()
+        elif inner_mode == "epoch":
+            _inner_epoch()
         else:
             _inner()
         return
@@ -825,6 +1003,18 @@ def _main_measure(mode: str) -> None:
             # batch path is orders of magnitude slower on CPU; the engine
             # shedding most of a 50k/s offer is the honest record)
             ladder = [(128, 1, 2048, 16, 1800.0)]
+    elif mode == "epoch":
+        # (validators, timeout) → run_inner's (sets, keys, validators,
+        # batch, timeout) plumbing; on a wedged tunnel only the CPU-sized
+        # rung runs (the acceptance shape: >=32k validators on JAX:CPU)
+        ladder = [(0, 0, v, 0, t) for v, t in _EPOCH_LADDER]
+        if "BENCH_VALIDATORS" in os.environ:
+            ladder = [
+                (0, 0, N_VALIDATORS, 0,
+                 float(os.environ.get("BENCH_TIMEOUT", "1350"))),
+            ]
+        elif fallback:
+            ladder = ladder[-1:]
     elif "BENCH_SETS" in os.environ:
         ladder = [
             (N_SETS, KEYS_PER_SET, N_VALIDATORS, BATCH,
@@ -852,17 +1042,18 @@ def _main_measure(mode: str) -> None:
     ):
         return
     # every rung failed: emit an honest failure record rather than nothing
-    metric = (
-        "firehose_attestations_verified_per_s"
-        if mode == "firehose"
-        else "bls_attestation_sets_verified_per_s"
-    )
+    metric = {
+        "firehose": "firehose_attestations_verified_per_s",
+        "epoch": "epoch_validators_per_s",
+    }.get(mode, "bls_attestation_sets_verified_per_s")
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": 0.0,
-                "unit": "att/s" if mode == "firehose" else "sets/s",
+                "unit": {
+                    "firehose": "att/s", "epoch": "validators/s"
+                }.get(mode, "sets/s"),
                 "vs_baseline": 0.0,
                 "platform": platform,
                 "fallback": fallback,

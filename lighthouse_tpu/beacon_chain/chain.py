@@ -148,6 +148,20 @@ class BeaconChain:
         self.head = ChainHead(
             root=genesis_root, slot=genesis_state.slot, state=genesis_state
         )
+        # Device epoch engine (lighthouse_tpu/epoch_engine): when the
+        # backend seam selects the device path, bind the anchor state's
+        # registry mirror up front so the chain's first epoch boundary is a
+        # journal-delta sync, not a full Python-object gather. process_slots
+        # reaches the engine through the process_epoch seam on every
+        # subsequent boundary.
+        from .. import epoch_engine
+
+        self.epoch_engine = epoch_engine
+        if epoch_engine.device_backend_active():
+            try:
+                epoch_engine.prepare_state(genesis_state)
+            except Exception as e:  # noqa: BLE001 — engine warm-up best-effort
+                log.warning("epoch engine warm-up failed", error=str(e))
         self._seen_blocks: set[bytes] = {genesis_root}
         # backfill anchor (historical_blocks.rs): the oldest canonical block
         # we hold; checkpoint-synced chains fill backwards from here

@@ -36,6 +36,11 @@ def _spec(args):
         from . import bls
 
         bls.set_backend(backend)
+    epoch_backend = getattr(args, "epoch_backend", None)
+    if epoch_backend:
+        from . import epoch_engine
+
+        epoch_engine.set_backend(epoch_backend)
 
     kwargs = {}
     for fork in ("altair", "bellatrix", "capella", "deneb", "electra"):
@@ -60,6 +65,14 @@ def _add_spec_flags(p):
              "seam, crypto/bls/src/lib.rs:8-18): tpu = JAX device kernels "
              "(the default), native = C++ CPU parity backend, oracle = pure "
              "Python. Unset = keep the process's current backend.",
+    )
+    p.add_argument(
+        "--epoch-backend", default=None, choices=("auto", "device", "numpy"),
+        help="epoch-processing backend (lighthouse_tpu/epoch_engine): "
+             "device = fused jitted sweep over the device-resident registry "
+             "mirror, numpy = columnar host path, auto = device iff an "
+             "accelerator backs JAX. Unset = keep the process's current "
+             "backend (env LIGHTHOUSE_EPOCH_BACKEND, default auto).",
     )
     p.add_argument(
         "--platform", default="auto", choices=("auto", "cpu", "tpu"),

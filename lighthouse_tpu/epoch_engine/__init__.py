@@ -1,0 +1,96 @@
+"""Device-resident epoch engine: jit/sharded single-pass epoch processing.
+
+The backend seam mirrors ``lighthouse_tpu.bls``: a module-level registry
+selected by ``set_backend`` or the ``LIGHTHOUSE_EPOCH_BACKEND`` environment
+variable, with everything above it (``per_epoch.process_epoch``, and through
+it ``state_advance``/``beacon_chain``) backend-blind.
+
+Backends:
+
+* ``numpy``  — the columnar host path in ``state_transition/per_epoch.py``.
+* ``device`` — the fused jitted sweep (``engine.py`` + ``kernels.py``) over
+  a device-resident registry mirror (``mirror.py``); falls back to numpy
+  per-state only for forks the kernel does not cover (electra+).
+* ``auto``   — the default: ``device`` when an accelerator platform (tpu/
+  gpu) backs JAX, ``numpy`` otherwise, so CPU-only test tiers never pay
+  kernel compiles they didn't ask for.
+
+This module stays import-light (no jax) — the journal marks in block
+processing must stay free when the engine is off.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .deltas import (  # noqa: F401 — re-exported for the mutation sites
+    invalidate_registry_journal,
+    journal_of,
+    mark_registry_delta,
+)
+
+_BACKEND = os.environ.get("LIGHTHOUSE_EPOCH_BACKEND", "auto")
+_AUTO_DECISION: bool | None = None
+
+
+def set_backend(name: str) -> None:
+    global _BACKEND, _AUTO_DECISION
+    if name not in ("auto", "device", "numpy"):
+        raise ValueError(f"unknown epoch backend {name!r}")
+    _BACKEND = name
+    _AUTO_DECISION = None
+
+
+def get_backend() -> str:
+    return _BACKEND
+
+
+def _accelerator_present() -> bool:
+    """auto-mode probe, memoized: is JAX backed by an accelerator? Never
+    *initiates* a device tunnel probe beyond what jax.devices() implies —
+    callers in CPU-only tiers have already pinned JAX_PLATFORMS=cpu."""
+    global _AUTO_DECISION
+    if _AUTO_DECISION is None:
+        try:
+            import jax
+
+            _AUTO_DECISION = jax.devices()[0].platform in ("tpu", "gpu")
+        except Exception:  # noqa: BLE001 — no jax / no devices: numpy path
+            _AUTO_DECISION = False
+    return _AUTO_DECISION
+
+
+def device_backend_active() -> bool:
+    if _BACKEND == "numpy":
+        return False
+    if _BACKEND == "device":
+        return True
+    return _accelerator_present()
+
+
+def maybe_process_epoch_on_device(spec, state) -> bool:
+    """The ``process_epoch`` seam: True when the device engine fully handled
+    the epoch transition, False when the numpy path should run."""
+    if not device_backend_active():
+        return False
+    from .engine import process_epoch_on_device
+
+    return process_epoch_on_device(spec, state)
+
+
+def prepare_state(state, sharding=None):
+    """Bind mirror + delta journal ahead of the first boundary (chain /
+    state_advance warm-up). No-op unless the device backend is active."""
+    if not device_backend_active():
+        return None
+    from .engine import prepare_state as _prep
+
+    return _prep(state, sharding=sharding)
+
+
+def engine_stats(state) -> dict | None:
+    """Mirror counters for observability / the --epoch bench."""
+    from .engine import mirror_of
+
+    m = mirror_of(state)
+    return None if m is None else m.stats.as_dict()

@@ -1,0 +1,249 @@
+"""Single-pass device epoch processing behind ``process_epoch``.
+
+Orchestrates one epoch boundary on the accelerator: sync the registry mirror
+(delta scatter or first-bind gather), upload the flat per-epoch columns
+(balances, inactivity, participation — or the phase0 attestation masks),
+launch the fused sweep (kernels.py), apply the scalar justification /
+finalization decisions to the checkpoint objects, write back the changed
+registry rows, and run the residual host-side stages (vote/slashings/randao
+resets, historical accumulators, participation rotation, sync-committee
+rotation) in exactly the numpy path's order. Everything per-validator is the
+one jitted kernel; everything here is O(changed rows + attestations).
+
+Fork coverage: phase0 and the altair family (altair/bellatrix/capella/deneb
+— they share the participation-flag epoch transition and differ only in
+constants baked into ``EpochConsts``). Electra's pending-deposit /
+consolidation sweeps are not kernelized; those states fall back to numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .kernels import consts_for, run_sweep
+from .mirror import RegistryMirror
+
+_SUPPORTED_FORKS = ("phase0", "altair", "bellatrix", "capella", "deneb")
+
+_MIRROR_ATTR = "_epoch_mirror"
+
+
+def supported_fork(fork: str) -> bool:
+    return fork in _SUPPORTED_FORKS
+
+
+def mirror_of(state, create: bool = False,
+              sharding=None) -> RegistryMirror | None:
+    m = getattr(state, _MIRROR_ATTR, None)
+    if m is None and create:
+        m = RegistryMirror(sharding=sharding)
+        object.__setattr__(state, _MIRROR_ATTR, m)
+    return m
+
+
+def prepare_state(state, sharding=None) -> RegistryMirror | None:
+    """Bind a mirror + delta journal ahead of the first epoch boundary so
+    block processing starts journaling immediately (state_advance / chain
+    warm-up hook). No-op for forks the kernel does not cover — an electra
+    state would otherwise pay a full registry gather every epoch only for
+    process_epoch_on_device to refuse it and the numpy path to invalidate
+    the journal again."""
+    if not supported_fork(getattr(state, "fork_name", "phase0")):
+        return None
+    m = mirror_of(state, create=True, sharding=sharding)
+    if sharding is not None:
+        m.sharding = sharding
+    m.sync(state)
+    return m
+
+
+def process_epoch_on_device(spec, state, sharding=None) -> bool:
+    """Run one epoch transition through the device engine. Returns False
+    (state untouched) when the state's fork family is not kernelized."""
+    fork = getattr(state, "fork_name", "phase0")
+    if not supported_fork(fork):
+        return False
+    from ..state_transition.beacon_state_util import get_current_epoch
+
+    mirror = mirror_of(state, create=True, sharding=sharding)
+    mirror.sync(state)
+
+    consts = consts_for(spec, fork)
+    cur_ep = get_current_epoch(spec, state)
+    cols = dict(mirror.device)
+    cols["balances"] = mirror.pad_and_put(
+        np.asarray(state.balances, dtype=np.uint64)
+    )
+    if fork == "phase0":
+        _phase0_host_columns(spec, state, mirror, cols)
+    else:
+        cols["inactivity"] = mirror.pad_and_put(
+            np.asarray(state.inactivity_scores, dtype=np.uint64)
+        )
+        cols["prev_part"] = mirror.pad_and_put(
+            np.asarray(state.previous_epoch_participation, dtype=np.uint8)
+        )
+        cols["cur_part"] = mirror.pad_and_put(
+            np.asarray(state.current_epoch_participation, dtype=np.uint8)
+        )
+
+    bits = np.asarray(state.justification_bits, dtype=bool)
+    scalars = {
+        "cur_epoch": np.uint64(cur_ep),
+        "finalized_epoch": np.uint64(state.finalized_checkpoint.epoch),
+        "prev_justified_epoch": np.uint64(
+            state.previous_justified_checkpoint.epoch
+        ),
+        "cur_justified_epoch": np.uint64(
+            state.current_justified_checkpoint.epoch
+        ),
+        "bits": bits.copy(),
+        "slash_sum": np.uint64(
+            int(np.asarray(state.slashings, dtype=np.uint64).sum())
+        ),
+    }
+
+    outs = run_sweep(consts, cols, scalars)
+
+    _apply_justification(spec, state, outs)
+    n = mirror.n
+    state.balances = np.asarray(outs["balances"])[:n].copy()
+    if fork != "phase0":
+        state.inactivity_scores = np.asarray(outs["inactivity"])[:n].copy()
+        mirror.stats.device_to_host_bytes += n * 8
+    mirror.stats.device_to_host_bytes += n * 8
+    mirror.apply_outputs(state, outs)
+
+    _host_tail(spec, state, fork)
+    return True
+
+
+# =============================================================================
+# host-side stages
+# =============================================================================
+
+
+class _MaskCols:
+    """The slice of ``per_epoch._Cols`` that ``_attesting_mask`` reads,
+    served from the mirror's host shadows — no Python-object re-gather."""
+
+    def __init__(self, mirror):
+        self.n = mirror.n
+        self.slashed = mirror.shadow["slashed"][: mirror.n]
+
+
+def _phase0_host_columns(spec, state, mirror, cols) -> None:
+    """Resolve phase0 pending attestations into per-validator columns: the
+    unslashed source/target/head masks and the earliest-inclusion
+    (delay, proposer) pair — the only stage that must walk attestations."""
+    from ..state_transition.per_epoch import (
+        _attesting_mask,
+        _matching_attestations,
+        _matching_head_attestations,
+        _matching_target_attestations,
+    )
+    from ..state_transition.beacon_state_util import (
+        get_attesting_indices,
+        get_current_epoch,
+    )
+
+    hcols = _MaskCols(mirror)
+    cur_ep = get_current_epoch(spec, state)
+    n = hcols.n
+    zeros = np.zeros(n, dtype=bool)
+    prev_ep = max(cur_ep - 1, 0)
+    cur_tgt = (
+        _attesting_mask(
+            spec, state,
+            _matching_target_attestations(spec, state, cur_ep), hcols,
+        )
+        if cur_ep > 1
+        else zeros
+    )
+    if cur_ep > 0:
+        src_atts = _matching_attestations(spec, state, prev_ep)
+        src = _attesting_mask(spec, state, src_atts, hcols)
+        tgt = _attesting_mask(
+            spec, state,
+            _matching_target_attestations(spec, state, prev_ep), hcols,
+        )
+        head = _attesting_mask(
+            spec, state,
+            _matching_head_attestations(spec, state, prev_ep), hcols,
+        )
+    else:
+        src_atts = []
+        src = tgt = head = zeros
+    earliest: dict[int, tuple[int, int]] = {}
+    for a in src_atts:
+        idx = get_attesting_indices(spec, state, a.data, a.aggregation_bits)
+        for i in idx:
+            i = int(i)
+            cand = (int(a.inclusion_delay), int(a.proposer_index))
+            if i not in earliest or cand[0] < earliest[i][0]:
+                earliest[i] = cand
+    incl_delay = np.ones(n, dtype=np.uint64)
+    incl_proposer = np.zeros(n, dtype=np.int32)
+    has_incl = np.zeros(n, dtype=bool)
+    for i, (delay, proposer) in earliest.items():
+        incl_delay[i] = delay
+        incl_proposer[i] = proposer
+        has_incl[i] = True
+    cols["src_mask"] = mirror.pad_and_put(src, fill=False)
+    cols["tgt_mask"] = mirror.pad_and_put(tgt, fill=False)
+    cols["head_mask"] = mirror.pad_and_put(head, fill=False)
+    cols["cur_tgt_mask"] = mirror.pad_and_put(cur_tgt, fill=False)
+    cols["incl_delay"] = mirror.pad_and_put(incl_delay, fill=1)
+    cols["incl_proposer"] = mirror.pad_and_put(incl_proposer, fill=0)
+    cols["has_incl"] = mirror.pad_and_put(has_incl, fill=False)
+
+
+def _apply_justification(spec, state, outs) -> None:
+    """Scalar checkpoint bookkeeping from the kernel's decision flags, in
+    _weigh_justification_and_finalization's exact order."""
+    if not bool(outs["do_just"]):
+        return
+    from ..state_transition.beacon_state_util import (
+        get_block_root,
+        get_current_epoch,
+        get_previous_epoch,
+    )
+    from ..types.containers import Checkpoint
+
+    prev_ep = get_previous_epoch(spec, state)
+    cur_ep = get_current_epoch(spec, state)
+    old_prev = state.previous_justified_checkpoint
+    old_cur = state.current_justified_checkpoint
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    if bool(outs["cj_prev"]):
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=prev_ep, root=get_block_root(spec, state, prev_ep)
+        )
+    if bool(outs["cj_cur"]):
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=cur_ep, root=get_block_root(spec, state, cur_ep)
+        )
+    state.justification_bits = np.asarray(outs["bits"], dtype=bool).copy()
+    sel = int(outs["fin_sel"])
+    if sel == 1:
+        state.finalized_checkpoint = old_prev
+    elif sel == 2:
+        state.finalized_checkpoint = old_cur
+
+
+def _host_tail(spec, state, fork: str) -> None:
+    """The non-validator-axis epoch stages, in the numpy path's order."""
+    from ..state_transition import per_epoch as pe
+
+    pe.process_eth1_data_reset(spec, state)
+    pe.process_slashings_reset(spec, state)
+    pe.process_randao_mixes_reset(spec, state)
+    pe.process_historical_roots_update(spec, state)
+    if fork == "phase0":
+        state.previous_epoch_attestations = list(
+            state.current_epoch_attestations
+        )
+        state.current_epoch_attestations = []
+    else:
+        pe.process_participation_flag_updates(spec, state)
+        pe.process_sync_committee_updates(spec, state)

@@ -1,0 +1,535 @@
+"""Fused single-pass epoch kernels over the validator axis.
+
+One ``jit``-compiled sweep per fork family (phase0 / altair-like) computes
+everything ``per_epoch.py`` does per validator — justification balances,
+inactivity scores, rewards/penalties, registry updates (eligibility,
+ejections with exact exit-queue semantics, the churn-limited activation
+queue), slashing penalties, and hysteresis effective-balance updates — as
+one XLA program. The validator axis is padded to a shape bucket so the
+registry can grow without recompiling, and padding rows are arithmetic
+no-ops (inactive, zero-balance, far-future epochs).
+
+Bit-exactness contract: every expression mirrors the numpy path in
+``state_transition/per_epoch.py`` including its uint64 wrap-around
+semantics, so the parity suite (tests/test_epoch_engine.py) can assert
+field-for-field identity. Sequential spec constructs are vectorized in
+closed form:
+
+* exit queue — ``initiate_validator_exit``'s per-validator loop assigns
+  epoch ``eq0 + (min(c0, churn) + rank) // churn`` to the rank-th ejected
+  validator, where ``eq0`` is the current max exit epoch and ``c0`` its
+  occupancy (the loop only ever rolls one epoch forward at a time because
+  ``eq0`` is the global max);
+* activation queue — a device ``lexsort`` over (eligibility epoch, index)
+  replaces the host sort, with the churn limit applied by sorted position.
+
+Scalar decisions that touch non-array state (which checkpoint became
+justified/finalized) are returned as flags; the host applies the Checkpoint
+objects. Sharding: callers may lay the inputs out with a NamedSharding over
+the validator axis — the reductions/sorts lower to cross-device collectives
+under GSPMD, the same mesh machinery the BLS kernels use.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+
+# phase0 constant (per_epoch.BASE_REWARDS_PER_EPOCH)
+BASE_REWARDS_PER_EPOCH = 4
+# altair participation weights (per_block.PARTICIPATION_FLAG_WEIGHTS)
+PARTICIPATION_FLAG_WEIGHTS = (14, 26, 14)
+WEIGHT_DENOMINATOR = 64
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+
+
+class EpochConsts(NamedTuple):
+    """Hashable spec snapshot baked into the jitted sweep (static arg)."""
+
+    family: str  # "phase0" | "altair"
+    effective_balance_increment: int
+    max_effective_balance: int
+    ejection_balance: int
+    min_per_epoch_churn_limit: int
+    churn_limit_quotient: int
+    max_seed_lookahead: int
+    min_validator_withdrawability_delay: int
+    min_epochs_to_inactivity_penalty: int
+    base_reward_factor: int
+    proposer_reward_quotient: int
+    epochs_per_slashings_vector: int
+    proportional_slashing_multiplier: int
+    # phase0 only
+    inactivity_penalty_quotient: int
+    # altair family only
+    inactivity_score_bias: int
+    inactivity_score_recovery_rate: int
+    inactivity_penalty_quotient_altair: int
+    # deneb+ caps the activation churn
+    cap_activation_churn: bool
+    max_per_epoch_activation_churn_limit: int
+
+
+def consts_for(spec, fork: str) -> EpochConsts:
+    from ..types.spec import fork_at_least, proportional_slashing_multiplier_for
+
+    family = "phase0" if fork == "phase0" else "altair"
+    mult = proportional_slashing_multiplier_for(spec, fork)
+    return EpochConsts(
+        family=family,
+        effective_balance_increment=spec.effective_balance_increment,
+        max_effective_balance=spec.max_effective_balance,
+        ejection_balance=spec.ejection_balance,
+        min_per_epoch_churn_limit=spec.min_per_epoch_churn_limit,
+        churn_limit_quotient=spec.churn_limit_quotient,
+        max_seed_lookahead=spec.max_seed_lookahead,
+        min_validator_withdrawability_delay=(
+            spec.min_validator_withdrawability_delay
+        ),
+        min_epochs_to_inactivity_penalty=spec.min_epochs_to_inactivity_penalty,
+        base_reward_factor=spec.base_reward_factor,
+        proposer_reward_quotient=spec.proposer_reward_quotient,
+        epochs_per_slashings_vector=spec.preset.EPOCHS_PER_SLASHINGS_VECTOR,
+        proportional_slashing_multiplier=mult,
+        inactivity_penalty_quotient=spec.inactivity_penalty_quotient,
+        inactivity_score_bias=spec.inactivity_score_bias,
+        inactivity_score_recovery_rate=spec.inactivity_score_recovery_rate,
+        inactivity_penalty_quotient_altair=(
+            spec.inactivity_penalty_quotient_altair
+        ),
+        cap_activation_churn=fork_at_least(fork, "deneb"),
+        max_per_epoch_activation_churn_limit=(
+            spec.max_per_epoch_activation_churn_limit
+        ),
+    )
+
+
+def bucket(n: int) -> int:
+    """Validator-axis shape bucket: power of two >= 256 (multiple of any
+    mesh size, and the registry grows without recompiles)."""
+    b = 256
+    while b < n:
+        b *= 2
+    return b
+
+
+# =============================================================================
+# kernel body (pure jnp; jitted via _compiled)
+# =============================================================================
+
+
+def _u64(x):
+    import jax.numpy as jnp
+
+    return jnp.uint64(x)
+
+
+def _isqrt_u64(t):
+    """Exact integer sqrt of a u64 scalar (values << 2^63). float64 seeds the
+    root; two correction steps each way absorb the <=1-ulp rounding."""
+    import jax.numpy as jnp
+
+    s = jnp.floor(jnp.sqrt(t.astype(jnp.float64))).astype(jnp.uint64)
+    one = _u64(1)
+    for _ in range(2):
+        s = jnp.where((s + one) * (s + one) <= t, s + one, s)
+    for _ in range(2):
+        s = jnp.where((s > 0) & (s * s > t), s - one, s)
+    return s
+
+
+def _justification(C, do_just, total, prev_tb, cur_tb, bits,
+                   prev_jcp_ep, cur_jcp_ep, fin_ep, cur_ep):
+    """New justification bits + checkpoint-update flags + finalized selector
+    (0 none / 1 old-previous-justified / 2 old-current-justified)."""
+    import jax.numpy as jnp
+
+    three, two = _u64(3), _u64(2)
+    cond_prev = do_just & (prev_tb * three >= total * two)
+    cond_cur = do_just & (cur_tb * three >= total * two)
+    nb0 = cond_cur
+    nb1 = bits[0] | cond_prev
+    nb2, nb3 = bits[1], bits[2]
+    r1 = nb1 & nb2 & nb3 & (prev_jcp_ep + three == cur_ep)
+    r2 = nb1 & nb2 & (prev_jcp_ep + two == cur_ep)
+    r3 = nb0 & nb1 & nb2 & (cur_jcp_ep + two == cur_ep)
+    r4 = nb0 & nb1 & (cur_jcp_ep + _u64(1) == cur_ep)
+    fin_sel = jnp.where(
+        do_just & (r3 | r4), 2, jnp.where(do_just & (r1 | r2), 1, 0)
+    ).astype(jnp.int32)
+    new_bits = jnp.stack([
+        jnp.where(do_just, nb0, bits[0]),
+        jnp.where(do_just, nb1, bits[1]),
+        jnp.where(do_just, nb2, bits[2]),
+        jnp.where(do_just, nb3, bits[3]),
+    ])
+    f_new = jnp.where(
+        fin_sel == 2, cur_jcp_ep, jnp.where(fin_sel == 1, prev_jcp_ep, fin_ep)
+    )
+    return new_bits, cond_prev, cond_cur, fin_sel, f_new
+
+
+def _registry_updates(C: EpochConsts, cur_ep, f_new, effective,
+                      activation, exit_ep, withdrawable, eligibility,
+                      active_cur):
+    """Eligibility flags, vectorized exit queue, churn-limited activation
+    queue (process_registry_updates, non-electra)."""
+    import jax.numpy as jnp
+
+    far = _u64(FAR_FUTURE_EPOCH)
+    one = _u64(1)
+    elig_new = jnp.where(
+        (eligibility == far)
+        & (effective == _u64(C.max_effective_balance)),
+        cur_ep + one,
+        eligibility,
+    )
+    n_active = jnp.sum(active_cur.astype(jnp.uint64))
+    churn = jnp.maximum(
+        _u64(C.min_per_epoch_churn_limit),
+        n_active // _u64(C.churn_limit_quotient),
+    )
+    # -- ejections: exact initiate_validator_exit queue semantics ----------
+    eject = (
+        active_cur
+        & (effective <= _u64(C.ejection_balance))
+        & (exit_ep == far)
+    )
+    has_exit = exit_ep != far
+    min_exit = cur_ep + one + _u64(C.max_seed_lookahead)
+    eq0 = jnp.maximum(
+        jnp.max(jnp.where(has_exit, exit_ep, _u64(0))), min_exit
+    )
+    c0 = jnp.sum((exit_ep == eq0).astype(jnp.uint64))
+    c_eff = jnp.minimum(c0, churn)
+    rank = jnp.cumsum(eject.astype(jnp.uint64)) - one  # valid where eject
+    assigned = eq0 + (c_eff + rank) // churn
+    exit_new = jnp.where(eject, assigned, exit_ep)
+    wd_new = jnp.where(
+        eject,
+        assigned + _u64(C.min_validator_withdrawability_delay),
+        withdrawable,
+    )
+    # -- activation queue: FIFO by (eligibility epoch, index) --------------
+    cand = (elig_new <= f_new) & (activation == far)
+    limit = churn
+    if C.cap_activation_churn:
+        limit = jnp.minimum(
+            _u64(C.max_per_epoch_activation_churn_limit), limit
+        )
+    n = effective.shape[0]
+    idx = jnp.arange(n, dtype=jnp.uint64)
+    order = jnp.lexsort((idx, jnp.where(cand, elig_new, far)))
+    pos = jnp.arange(n, dtype=jnp.uint64)
+    sel_at_pos = (pos < limit) & cand[order]
+    taken = jnp.zeros(n, dtype=bool).at[order].set(sel_at_pos)
+    act_new = jnp.where(taken, min_exit, activation)
+    return elig_new, exit_new, wd_new, act_new
+
+
+def _slashings(C: EpochConsts, cur_ep, total, slash_sum, effective, slashed,
+               withdrawable_snapshot, balances):
+    import jax.numpy as jnp
+
+    inc = _u64(C.effective_balance_increment)
+    adjusted = jnp.minimum(
+        slash_sum * _u64(C.proportional_slashing_multiplier), total
+    )
+    target_wd = cur_ep + _u64(C.epochs_per_slashings_vector // 2)
+    hit = slashed & (withdrawable_snapshot == target_wd)
+    penalty = effective // inc * adjusted // total * inc
+    dec = jnp.minimum(penalty, balances)
+    return jnp.where(hit, balances - dec, balances)
+
+
+def _effective_updates(C: EpochConsts, balances, effective):
+    import jax.numpy as jnp
+
+    inc = _u64(C.effective_balance_increment)
+    hysteresis = inc // _u64(4)
+    down = hysteresis  # HYSTERESIS_DOWNWARD_MULTIPLIER = 1
+    up = hysteresis * _u64(5)  # HYSTERESIS_UPWARD_MULTIPLIER = 5
+    need = (balances + down < effective) | (effective + up < balances)
+    capped = jnp.minimum(
+        balances - balances % inc, _u64(C.max_effective_balance)
+    )
+    return jnp.where(need, capped, effective)
+
+
+def _sweep_altair(C: EpochConsts, cols, scalars):
+    import jax.numpy as jnp
+
+    effective = cols["effective"]
+    slashed = cols["slashed"]
+    activation = cols["activation"]
+    exit_ep = cols["exit"]
+    withdrawable = cols["withdrawable"]
+    eligibility = cols["eligibility"]
+    balances = cols["balances"]
+    inact = cols["inactivity"]
+    prev_part = cols["prev_part"]
+    cur_part = cols["cur_part"]
+
+    cur_ep = scalars["cur_epoch"]
+    fin_ep = scalars["finalized_epoch"]
+    prev_jcp_ep = scalars["prev_justified_epoch"]
+    cur_jcp_ep = scalars["cur_justified_epoch"]
+    bits = scalars["bits"]
+    slash_sum = scalars["slash_sum"]
+
+    inc = _u64(C.effective_balance_increment)
+    zero, one = _u64(0), _u64(1)
+    prev_ep = jnp.where(cur_ep > zero, cur_ep - one, zero)
+    active_cur = (activation <= cur_ep) & (cur_ep < exit_ep)
+    active_prev = (activation <= prev_ep) & (prev_ep < exit_ep)
+    total = jnp.maximum(
+        inc, jnp.sum(jnp.where(active_cur, effective, zero))
+    )
+
+    def flag_mask(part, flag, active_mask):
+        return active_mask & ((part & np.uint8(1 << flag)) != 0) & ~slashed
+
+    # --- justification & finalization ------------------------------------
+    prev_tgt = flag_mask(prev_part, TIMELY_TARGET_FLAG_INDEX, active_prev)
+    cur_tgt = flag_mask(cur_part, TIMELY_TARGET_FLAG_INDEX, active_cur)
+    prev_tb = jnp.maximum(inc, jnp.sum(jnp.where(prev_tgt, effective, zero)))
+    cur_tb = jnp.maximum(inc, jnp.sum(jnp.where(cur_tgt, effective, zero)))
+    do_just = cur_ep > one
+    new_bits, cj_prev, cj_cur, fin_sel, f_new = _justification(
+        C, do_just, total, prev_tb, cur_tb, bits,
+        prev_jcp_ep, cur_jcp_ep, fin_ep, cur_ep,
+    )
+
+    # --- inactivity updates (reads the just-updated finalized epoch) -----
+    do_rp = cur_ep > zero
+    eligible = active_prev | (slashed & (prev_ep + one < withdrawable))
+    delay_i = prev_ep.astype(jnp.int64) - f_new.astype(jnp.int64)
+    is_leak = delay_i > np.int64(C.min_epochs_to_inactivity_penalty)
+    s = inact
+    s1 = jnp.where(eligible & prev_tgt, s - jnp.minimum(one, s), s)
+    s1 = jnp.where(
+        eligible & ~prev_tgt, s1 + _u64(C.inactivity_score_bias), s1
+    )
+    s2 = jnp.where(
+        eligible & ~is_leak,
+        s1 - jnp.minimum(_u64(C.inactivity_score_recovery_rate), s1),
+        s1,
+    )
+    inact_new = jnp.where(do_rp, s2, s)
+
+    # --- rewards & penalties ---------------------------------------------
+    total_increments = total // inc
+    per_inc = inc * _u64(C.base_reward_factor) // _isqrt_u64(total)
+    base = (effective // inc) * per_inc
+    rewards = jnp.zeros_like(balances)
+    penalties = jnp.zeros_like(balances)
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        mask = flag_mask(prev_part, flag_index, active_prev)
+        flag_balance = jnp.maximum(
+            inc, jnp.sum(jnp.where(mask, effective, zero))
+        )
+        flag_increments = flag_balance // inc
+        attesters = eligible & mask
+        numer = base * (_u64(weight) * flag_increments)
+        denom = total_increments * _u64(WEIGHT_DENOMINATOR)
+        rewards = jnp.where(
+            attesters & ~is_leak, rewards + numer // denom, rewards
+        )
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties = jnp.where(
+                eligible & ~mask,
+                penalties
+                + base * _u64(weight) // _u64(WEIGHT_DENOMINATOR),
+                penalties,
+            )
+    non_target = eligible & ~prev_tgt
+    inact_denom = _u64(
+        C.inactivity_score_bias * C.inactivity_penalty_quotient_altair
+    )
+    penalties = jnp.where(
+        non_target,
+        penalties + effective * inact_new // inact_denom,
+        penalties,
+    )
+    bal = balances + jnp.where(do_rp, rewards, zero)
+    pen = jnp.where(do_rp, penalties, zero)
+    bal = bal - jnp.minimum(pen, bal)
+
+    # --- registry updates / slashings / effective balances ---------------
+    elig_new, exit_new, wd_new, act_new = _registry_updates(
+        C, cur_ep, f_new, effective, activation, exit_ep,
+        withdrawable, eligibility, active_cur,
+    )
+    bal = _slashings(
+        C, cur_ep, total, slash_sum, effective, slashed, withdrawable, bal
+    )
+    eff_new = _effective_updates(C, bal, effective)
+
+    return {
+        "balances": bal,
+        "inactivity": inact_new,
+        "effective": eff_new,
+        "activation": act_new,
+        "exit": exit_new,
+        "withdrawable": wd_new,
+        "eligibility": elig_new,
+        "bits": new_bits,
+        "cj_prev": cj_prev,
+        "cj_cur": cj_cur,
+        "fin_sel": fin_sel,
+        "f_new": f_new,
+        "do_just": do_just,
+    }
+
+
+def _sweep_phase0(C: EpochConsts, cols, scalars):
+    import jax.numpy as jnp
+
+    effective = cols["effective"]
+    slashed = cols["slashed"]
+    activation = cols["activation"]
+    exit_ep = cols["exit"]
+    withdrawable = cols["withdrawable"]
+    eligibility = cols["eligibility"]
+    balances = cols["balances"]
+    src_mask = cols["src_mask"]
+    tgt_mask = cols["tgt_mask"]
+    head_mask = cols["head_mask"]
+    cur_tgt_mask = cols["cur_tgt_mask"]
+    incl_delay = cols["incl_delay"]
+    incl_proposer = cols["incl_proposer"]
+    has_incl = cols["has_incl"]
+
+    cur_ep = scalars["cur_epoch"]
+    fin_ep = scalars["finalized_epoch"]
+    prev_jcp_ep = scalars["prev_justified_epoch"]
+    cur_jcp_ep = scalars["cur_justified_epoch"]
+    bits = scalars["bits"]
+    slash_sum = scalars["slash_sum"]
+
+    inc = _u64(C.effective_balance_increment)
+    zero, one = _u64(0), _u64(1)
+    prev_ep = jnp.where(cur_ep > zero, cur_ep - one, zero)
+    active_cur = (activation <= cur_ep) & (cur_ep < exit_ep)
+    active_prev = (activation <= prev_ep) & (prev_ep < exit_ep)
+    total = jnp.maximum(
+        inc, jnp.sum(jnp.where(active_cur, effective, zero))
+    )
+
+    # --- justification (target masks are host-gathered, unslashed) -------
+    prev_tb = jnp.maximum(
+        inc, jnp.sum(jnp.where(tgt_mask, effective, zero))
+    )
+    cur_tb = jnp.maximum(
+        inc, jnp.sum(jnp.where(cur_tgt_mask, effective, zero))
+    )
+    do_just = cur_ep > one
+    new_bits, cj_prev, cj_cur, fin_sel, f_new = _justification(
+        C, do_just, total, prev_tb, cur_tb, bits,
+        prev_jcp_ep, cur_jcp_ep, fin_ep, cur_ep,
+    )
+
+    # --- rewards & penalties ---------------------------------------------
+    do_rp = cur_ep > zero
+    eligible = active_prev | (slashed & (prev_ep + one < withdrawable))
+    delay_i = prev_ep.astype(jnp.int64) - f_new.astype(jnp.int64)
+    is_leak = delay_i > np.int64(C.min_epochs_to_inactivity_penalty)
+    base = (
+        effective * _u64(C.base_reward_factor)
+        // _isqrt_u64(total)
+        // _u64(BASE_REWARDS_PER_EPOCH)
+    )
+    total_increments = total // inc
+    rewards = jnp.zeros_like(balances)
+    penalties = jnp.zeros_like(balances)
+    for mask in (src_mask, tgt_mask, head_mask):
+        att_balance = jnp.maximum(
+            inc, jnp.sum(jnp.where(mask, effective, zero))
+        )
+        increments = att_balance // inc
+        attesters = eligible & mask
+        rewards = jnp.where(
+            attesters,
+            rewards
+            + jnp.where(
+                is_leak, base, base * increments // total_increments
+            ),
+            rewards,
+        )
+        penalties = jnp.where(eligible & ~mask, penalties + base, penalties)
+
+    # proposer & inclusion-delay micro-rewards (earliest inclusion, host-
+    # resolved into per-validator delay/proposer columns)
+    ok = has_incl & ~slashed
+    proposer_reward = base // _u64(C.proposer_reward_quotient)
+    rewards = rewards.at[incl_proposer].add(
+        jnp.where(ok, proposer_reward, zero)
+    )
+    safe_delay = jnp.where(ok, incl_delay, one)
+    rewards = jnp.where(
+        ok, rewards + (base - proposer_reward) // safe_delay, rewards
+    )
+
+    # inactivity-leak penalties
+    leak_pen = (
+        _u64(BASE_REWARDS_PER_EPOCH) * base
+        - base // _u64(C.proposer_reward_quotient)
+    )
+    penalties = jnp.where(
+        eligible & is_leak, penalties + leak_pen, penalties
+    )
+    delay_u = delay_i.astype(jnp.uint64)
+    penalties = jnp.where(
+        eligible & ~tgt_mask & is_leak,
+        penalties
+        + effective * delay_u // _u64(C.inactivity_penalty_quotient),
+        penalties,
+    )
+
+    bal = balances + jnp.where(do_rp, rewards, zero)
+    pen = jnp.where(do_rp, penalties, zero)
+    bal = bal - jnp.minimum(pen, bal)
+
+    # --- registry updates / slashings / effective balances ---------------
+    elig_new, exit_new, wd_new, act_new = _registry_updates(
+        C, cur_ep, f_new, effective, activation, exit_ep,
+        withdrawable, eligibility, active_cur,
+    )
+    bal = _slashings(
+        C, cur_ep, total, slash_sum, effective, slashed, withdrawable, bal
+    )
+    eff_new = _effective_updates(C, bal, effective)
+
+    return {
+        "balances": bal,
+        "effective": eff_new,
+        "activation": act_new,
+        "exit": exit_new,
+        "withdrawable": wd_new,
+        "eligibility": elig_new,
+        "bits": new_bits,
+        "cj_prev": cj_prev,
+        "cj_cur": cj_cur,
+        "fin_sel": fin_sel,
+        "f_new": f_new,
+        "do_just": do_just,
+    }
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled(consts: EpochConsts):
+    """One jitted sweep per (fork family x spec constants); XLA's own cache
+    handles the per-shape-bucket specializations underneath."""
+    import jax
+
+    body = _sweep_phase0 if consts.family == "phase0" else _sweep_altair
+    return jax.jit(functools.partial(body, consts))
+
+
+def run_sweep(consts: EpochConsts, cols: dict, scalars: dict) -> dict:
+    return _compiled(consts)(cols, scalars)

@@ -1,0 +1,198 @@
+"""Device-resident columnar mirror of the validator registry.
+
+The numpy epoch path re-gathers every validator's fields out of Python
+objects into ``_Cols`` arrays each epoch — an O(n) interpreted loop that
+dwarfs the arithmetic at mainnet scale. The mirror gathers ONCE per state
+lineage, keeps the six epoch-processing registry columns as device arrays
+(struct-of-arrays), and between epochs applies only the rows the block-level
+delta journal (``deltas.py``) marked dirty: a handful of slashings/exits/
+deposits per epoch instead of a million-object sweep.
+
+Host numpy shadows of the same columns serve two jobs: computing the dirty
+rows' new values without a device round-trip, and diffing kernel outputs so
+the post-sweep write-back touches only the Python validator objects that
+actually changed. Balances / inactivity / participation live as numpy arrays
+on the state already and are re-uploaded wholesale each epoch (a flat
+device_put, not an object gather); the mirror accounts every host<->device
+byte so the ``--epoch`` bench can report the delta-update traffic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .deltas import install_journal, journal_of
+from .kernels import FAR_FUTURE_EPOCH, bucket
+
+_REG_DTYPES = {
+    "effective": np.uint64,
+    "slashed": np.bool_,
+    "activation": np.uint64,
+    "exit": np.uint64,
+    "withdrawable": np.uint64,
+    "eligibility": np.uint64,
+}
+
+_FIELD_ATTRS = {
+    "effective": "effective_balance",
+    "slashed": "slashed",
+    "activation": "activation_epoch",
+    "exit": "exit_epoch",
+    "withdrawable": "withdrawable_epoch",
+    "eligibility": "activation_eligibility_epoch",
+}
+
+# padding row: an inactive, zero-balance validator that every kernel stage
+# provably ignores
+_PAD_VALUES = {
+    "effective": 0,
+    "slashed": False,
+    "activation": FAR_FUTURE_EPOCH,
+    "exit": FAR_FUTURE_EPOCH,
+    "withdrawable": FAR_FUTURE_EPOCH,
+    "eligibility": FAR_FUTURE_EPOCH,
+}
+
+
+@dataclass
+class MirrorStats:
+    full_syncs: int = 0
+    delta_syncs: int = 0
+    dirty_rows: int = 0
+    host_to_device_bytes: int = 0
+    device_to_host_bytes: int = 0
+    epochs: int = 0
+    last_host_to_device_bytes: int = 0
+    writeback_rows: int = 0
+
+    def as_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+class RegistryMirror:
+    """Columnar registry mirror bound to one state object's lifetime."""
+
+    def __init__(self, sharding=None):
+        self.n = 0
+        self.n_pad = 0
+        self.device: dict = {}  # name -> jax array (padded)
+        self.shadow: dict[str, np.ndarray] = {}  # name -> numpy (padded)
+        self.sharding = sharding
+        self.stats = MirrorStats()
+
+    # -- host<->device helpers -------------------------------------------
+
+    def _put(self, arr: np.ndarray):
+        import jax
+
+        self.stats.host_to_device_bytes += arr.nbytes
+        self.stats.last_host_to_device_bytes += arr.nbytes
+        if self.sharding is not None:
+            return jax.device_put(arr, self.sharding)
+        return jax.device_put(arr)
+
+    def pad_and_put(self, arr: np.ndarray, fill=0):
+        """Pad a per-validator host array to the shape bucket and upload
+        (the per-epoch balances/participation/inactivity path)."""
+        if arr.shape[0] != self.n_pad:
+            padded = np.full(self.n_pad, fill, dtype=arr.dtype)
+            padded[: arr.shape[0]] = arr
+            arr = padded
+        return self._put(arr)
+
+    # -- sync -------------------------------------------------------------
+
+    def sync(self, state) -> None:
+        """Bring the device registry columns up to date with the state's
+        Python validator objects, by journal deltas when possible."""
+        self.stats.last_host_to_device_bytes = 0
+        vs = state.validators
+        n = len(vs)
+        j = journal_of(state)
+        if not self.device or j is None or not j.valid or n < j.n_base:
+            self._full_gather(state, n)
+            return
+        dirty = sorted(j.dirty.union(range(j.n_base, n)))
+        dirty = [i for i in dirty if i < n]
+        if n > self.n_pad:
+            self._regrow(n)
+        if dirty:
+            self._apply_rows(vs, dirty)
+        self.n = n
+        j.reset(n)
+        self.stats.delta_syncs += 1
+        self.stats.dirty_rows += len(dirty)
+
+    def _full_gather(self, state, n: int) -> None:
+        vs = state.validators
+        self.n = n
+        self.n_pad = bucket(n)
+        for name, dt in _REG_DTYPES.items():
+            attr = _FIELD_ATTRS[name]
+            col = np.full(self.n_pad, _PAD_VALUES[name], dtype=dt)
+            col[:n] = [getattr(v, attr) for v in vs]
+            self.shadow[name] = col
+            self.device[name] = self._put(col)
+        j = journal_of(state)
+        if j is None:
+            install_journal(state, n)
+        else:
+            j.reset(n)
+        self.stats.full_syncs += 1
+
+    def _regrow(self, n: int) -> None:
+        new_pad = bucket(n)
+        for name, dt in _REG_DTYPES.items():
+            col = np.full(new_pad, _PAD_VALUES[name], dtype=dt)
+            col[: self.n_pad] = self.shadow[name]
+            self.shadow[name] = col
+            self.device[name] = self._put(col)
+        self.n_pad = new_pad
+
+    def _apply_rows(self, vs, rows: list[int]) -> None:
+        idx = np.asarray(rows, dtype=np.int64)
+        for name, dt in _REG_DTYPES.items():
+            attr = _FIELD_ATTRS[name]
+            vals = np.asarray(
+                [getattr(vs[i], attr) for i in rows], dtype=dt
+            )
+            self.shadow[name][idx] = vals
+            self.device[name] = (
+                self.device[name].at[idx].set(vals)
+            )
+            self.stats.host_to_device_bytes += vals.nbytes + idx.nbytes
+            self.stats.last_host_to_device_bytes += vals.nbytes + idx.nbytes
+
+    # -- post-sweep write-back --------------------------------------------
+
+    def apply_outputs(self, state, outs: dict) -> None:
+        """Adopt the kernel's new registry columns as the device-resident
+        truth and write back only the changed rows to the Python objects."""
+        vs = state.validators
+        n = self.n
+        changed_total = 0
+        for name in _REG_DTYPES:
+            if name not in outs:
+                continue
+            new_dev = outs[name]
+            # owned host copy: the shadow must stay scatter-writable for the
+            # next delta sync (views of device buffers are read-only)
+            new_host = np.asarray(new_dev).copy()
+            self.stats.device_to_host_bytes += new_host.nbytes
+            old = self.shadow[name]
+            changed = np.nonzero(new_host[:n] != old[:n])[0]
+            if changed.size:
+                attr = _FIELD_ATTRS[name]
+                cast = bool if name == "slashed" else int
+                for i in changed:
+                    setattr(vs[int(i)], attr, cast(new_host[i]))
+                changed_total += int(changed.size)
+            self.shadow[name] = new_host
+            self.device[name] = new_dev
+        self.stats.writeback_rows += changed_total
+        self.stats.epochs += 1
+        j = journal_of(state)
+        if j is not None:
+            j.reset(n)

@@ -45,6 +45,13 @@ ALPHA = 3              # lookup concurrency
 MAX_LOOKUP_ROUNDS = 8
 _PING, _PONG, _FINDNODE, _NODES = 1, 2, 3, 4
 _MAX_NODES_PER_RESPONSE = 16
+# Liveness-checked eviction (discv5 pending-node semantics): before a full
+# bucket evicts its oldest record, the service PINGs it and only replaces it
+# if no packet arrives within this window. Unconditional LRU eviction lets
+# an attacker flush honest long-lived peers with a stream of fresh ENRs
+# (eclipse pressure); a live oldest node always survives.
+LIVENESS_TIMEOUT_S = 1.0
+_SERVE_TICK_S = 0.25   # serve-loop wakeup for pending-eviction expiry
 
 
 def _sign_payload(sk_scalar: int, content: bytes) -> bytes:
@@ -163,7 +170,14 @@ class RoutingTable:
         self._buckets: dict[int, list[ENR]] = {}
         self._lock = threading.Lock()
 
-    def admit(self, enr: ENR) -> bool:
+    def admit(self, enr: ENR, on_full=None) -> bool:
+        """Admit/refresh a record. On a full bucket: with ``on_full`` set
+        (the service's liveness path) the candidate is handed to
+        ``on_full(oldest, candidate)`` and NOT admitted yet — the caller
+        pings the oldest and either keeps it (drop candidate) or calls
+        ``replace``; without it, legacy LRU eviction applies (direct table
+        users/tests). ``on_full`` runs under the table lock and must not
+        call back into the table."""
         nid = enr.node_id
         if nid == self.local_id:
             return False
@@ -177,9 +191,31 @@ class RoutingTable:
                         bucket.append(enr)
                     return True
             if len(bucket) >= K_BUCKET:
+                if on_full is not None:
+                    on_full(bucket[0], enr)
+                    return False
                 bucket.pop(0)  # LRU eviction (head is oldest)
             bucket.append(enr)
             return True
+
+    def touch(self, node_id: bytes) -> None:
+        """Refresh a record to most-recently-seen (liveness proof)."""
+        d = log_distance(self.local_id, node_id)
+        with self._lock:
+            bucket = self._buckets.get(d, [])
+            for i, e in enumerate(bucket):
+                if e.node_id == node_id:
+                    bucket.append(bucket.pop(i))
+                    return
+
+    def replace(self, old_id: bytes, new_enr: ENR) -> bool:
+        """Swap a liveness-check failure for the pending candidate (same
+        bucket by construction; a vanished oldest still admits the new)."""
+        d = log_distance(self.local_id, old_id)
+        with self._lock:
+            bucket = self._buckets.get(d, [])
+            self._buckets[d] = [e for e in bucket if e.node_id != old_id]
+        return self.admit(new_enr)
 
     def remove(self, node_id: bytes) -> None:
         d = log_distance(self.local_id, node_id)
@@ -241,6 +277,11 @@ class DiscoveryService:
         self.peer_manager = peer_manager
         self._stopped = False
         self._thread: threading.Thread | None = None
+        # pending liveness-checked evictions: bucket distance -> (oldest
+        # node_id, candidate ENR, deadline). One pending slot per bucket
+        # (discv5); candidates arriving while a check is in flight drop.
+        self._pending_evictions: dict[int, tuple[bytes, ENR, float]] = {}
+        self._pending_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -271,7 +312,8 @@ class DiscoveryService:
 
     def _admit(self, enr: ENR) -> bool:
         """Verify + filter a remote record: signature, fork digest, and the
-        peer-manager's ban list all gate table admission."""
+        peer-manager's ban list all gate table admission. Full buckets go
+        through the liveness-checked eviction path instead of blind LRU."""
         if enr.node_id == self.enr.node_id:
             return False
         if enr.fork_digest != self.enr.fork_digest:
@@ -282,14 +324,85 @@ class DiscoveryService:
             node_id=enr.node_id, addr=enr.tcp_addr
         ):
             return False
-        return self.table.admit(enr)
+        return self.table.admit(enr, on_full=self._on_bucket_full)
+
+    # -- liveness-checked eviction ----------------------------------------
+
+    def _on_bucket_full(self, oldest: ENR, candidate: ENR) -> None:
+        """Called (under the table lock — no table calls here) when a
+        verified candidate hits a full bucket: ping the bucket's oldest
+        record and park the candidate. Any packet from the oldest before
+        the deadline cancels the eviction; expiry replaces it."""
+        d = log_distance(self.enr.node_id, oldest.node_id)
+        with self._pending_lock:
+            if d in self._pending_evictions:
+                return  # one pending check per bucket; extra candidates drop
+            self._pending_evictions[d] = (
+                oldest.node_id, candidate, time.monotonic() + LIVENESS_TIMEOUT_S,
+            )
+        self._send(oldest.udp_addr, _PING, b"")
+
+    def _note_liveness(self, node_id: bytes) -> None:
+        """A packet from ``node_id`` proves liveness: cancel any pending
+        eviction of it (candidate drops) and refresh its LRU position."""
+        d = log_distance(self.enr.node_id, node_id)
+        cancelled = False
+        with self._pending_lock:
+            pend = self._pending_evictions.get(d)
+            if pend is not None and pend[0] == node_id:
+                del self._pending_evictions[d]
+                cancelled = True
+        if cancelled:
+            self.table.touch(node_id)
+            log.debug(
+                "bucket eviction cancelled: oldest is alive",
+                node_id=node_id.hex()[:16],
+            )
+
+    def _expire_pending_evictions(self) -> None:
+        now = time.monotonic()
+        expired = []
+        with self._pending_lock:
+            for d, (old_id, cand, deadline) in list(
+                self._pending_evictions.items()
+            ):
+                if now >= deadline:
+                    expired.append((old_id, cand))
+                    del self._pending_evictions[d]
+        for old_id, cand in expired:
+            self.table.replace(old_id, cand)
+            log.debug(
+                "evicted unresponsive bucket head",
+                evicted=old_id.hex()[:16], admitted=cand.node_id.hex()[:16],
+            )
 
     # -- client side -------------------------------------------------------
 
-    def bootstrap(self, boot_enr: ENR) -> None:
-        """Admit a trusted boot record and ping it (teaches it our ENR)."""
-        self._admit(boot_enr)
+    def bootstrap(self, boot_enr: ENR) -> bool:
+        """Admit a trusted boot record and ping it (teaches it our ENR).
+        A rejected boot record is LOUD: a node bootstrapped from nothing has
+        no other way into the network, and a silently-dropped boot ENR
+        (bad signature, fork mismatch, banned) looks identical to an empty
+        network from the outside."""
+        admitted = self._admit(boot_enr)
+        if not admitted:
+            reason = "duplicate-or-pending"
+            if boot_enr.fork_digest != self.enr.fork_digest:
+                reason = "fork digest mismatch"
+            elif not boot_enr.verify():
+                reason = "invalid ENR signature"
+            elif self.peer_manager is not None and self.peer_manager.is_banned(
+                node_id=boot_enr.node_id, addr=boot_enr.tcp_addr
+            ):
+                reason = "banned"
+            log.warning(
+                "boot ENR rejected",
+                reason=reason,
+                node_id=boot_enr.node_id.hex()[:16],
+                addr=boot_enr.tcp_addr,
+            )
         self._send(boot_enr.udp_addr, _PING, b"")
+        return admitted
 
     def lookup(self, target: bytes | None = None, timeout: float = 2.0) -> list[ENR]:
         """Iterative FINDNODE toward ``target`` (random by default — the
@@ -339,17 +452,25 @@ class DiscoveryService:
             pass
 
     def _serve(self) -> None:
+        # bounded recv so pending-eviction deadlines fire even on an idle
+        # socket (the liveness check must conclude without inbound traffic)
+        self._sock.settimeout(_SERVE_TICK_S)
         while not self._stopped:
             try:
                 data, src = self._sock.recvfrom(65535)
+            except socket.timeout:
+                self._expire_pending_evictions()
+                continue
             except OSError:
                 return
+            self._expire_pending_evictions()
             try:
                 sender, off = ENR.decode(data)
                 kind = data[off]
                 body = data[off + 1 :]
             except (ValueError, IndexError):
                 continue
+            self._note_liveness(sender.node_id)
             self._admit(sender)
             if kind == _PING:
                 self._send(src, _PONG, b"")

@@ -31,6 +31,22 @@ from ..utils.logging import get_logger
 from .codec import MessageCodec, WireError
 from .transport import Transport
 
+
+def _shutdown_close(sock: socket.socket) -> None:
+    """shutdown(SHUT_RDWR) before close: on Linux, close() alone does NOT
+    tear down a connection whose fd another thread is blocked in recv() on —
+    the in-flight syscall pins the open file description, no FIN is sent,
+    and BOTH sides' read loops hang forever (the peer never learns the
+    connection died). shutdown() interrupts the blocked recv immediately."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
 log = get_logger("socket_transport")
 
 _GOSSIP, _REQ, _RESP, _ERROR, _HELLO = range(5)
@@ -245,10 +261,7 @@ class SocketTransport(Transport):
             peers = list(self._peers.values())
             self._peers.clear()
         for p in peers:
-            try:
-                p.sock.close()
-            except OSError:
-                pass
+            _shutdown_close(p.sock)
 
     # -- internals ---------------------------------------------------------
 
@@ -259,10 +272,7 @@ class SocketTransport(Transport):
             old = self._peers.get(addr)
             self._peers[addr] = peer
         if old is not None:
-            try:
-                old.sock.close()
-            except OSError:
-                pass
+            _shutdown_close(old.sock)
         peer.send_frame(
             _HELLO, bytes([len(self.local_addr)]) + self.local_addr.encode()
         )
@@ -294,10 +304,7 @@ class SocketTransport(Transport):
             for enr in self.discovery.table.all_records():
                 if enr.tcp_addr == peer.addr:
                     self.discovery.table.remove(enr.node_id)
-        try:
-            peer.sock.close()
-        except OSError:
-            pass
+        _shutdown_close(peer.sock)
         if why != "closed":
             log.warn("Peer dropped", addr=peer.addr, reason=why)
 
@@ -378,10 +385,7 @@ class SocketTransport(Transport):
                     self._peers[canonical] = peer
             if stale is not None:
                 stale.alive = False
-                try:
-                    stale.sock.close()
-                except OSError:
-                    pass
+                _shutdown_close(stale.sock)
             # reconnect suppression: a banned peer announcing its canonical
             # address through a fresh inbound connection is cut here
             if self.peer_manager.is_banned(addr=canonical):

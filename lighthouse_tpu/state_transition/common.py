@@ -75,6 +75,9 @@ def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
         exit_queue_epoch += 1
     v.exit_epoch = exit_queue_epoch
     v.withdrawable_epoch = exit_queue_epoch + spec.min_validator_withdrawability_delay
+    from ..epoch_engine import mark_registry_delta
+
+    mark_registry_delta(state, index)
 
 
 def slash_validator(
@@ -87,6 +90,9 @@ def slash_validator(
     v.withdrawable_epoch = max(
         v.withdrawable_epoch, epoch + spec.preset.EPOCHS_PER_SLASHINGS_VECTOR
     )
+    from ..epoch_engine import mark_registry_delta
+
+    mark_registry_delta(state, slashed_index)
     state.slashings[epoch % spec.preset.EPOCHS_PER_SLASHINGS_VECTOR] += (
         v.effective_balance
     )

@@ -140,6 +140,9 @@ def initiate_validator_exit_electra(spec, state, index: int) -> None:
     v.withdrawable_epoch = (
         exit_queue_epoch + spec.min_validator_withdrawability_delay
     )
+    from ..epoch_engine import mark_registry_delta
+
+    mark_registry_delta(state, index)
 
 
 def queue_excess_active_balance(spec, state, index: int) -> None:

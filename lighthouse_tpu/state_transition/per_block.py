@@ -978,6 +978,9 @@ def add_validator_to_registry(spec, state, data, amount_override=None):
             withdrawable_epoch=FAR_FUTURE_EPOCH,
         )
     ]
+    from ..epoch_engine import mark_registry_delta
+
+    mark_registry_delta(state, len(state.validators) - 1)
     state.balances = np.concatenate(
         [np.asarray(state.balances, dtype=np.uint64), [np.uint64(amount)]]
     )

@@ -64,6 +64,13 @@ class _Cols:
 
 
 def process_epoch(spec: ChainSpec, state) -> None:
+    # Backend seam (mirrors the BLS backend registry): the device epoch
+    # engine owns the whole transition when selected; otherwise the columnar
+    # numpy path below runs. See lighthouse_tpu/epoch_engine/.
+    from ..epoch_engine import maybe_process_epoch_on_device
+
+    if maybe_process_epoch_on_device(spec, state):
+        return
     fork = getattr(state, "fork_name", "phase0")
     if fork == "phase0":
         _process_epoch_phase0(spec, state)
@@ -117,6 +124,11 @@ def _unslashed_attesting_balance(spec, cols: _Cols, mask: np.ndarray) -> int:
 
 
 def _process_epoch_phase0(spec: ChainSpec, state) -> None:
+    # the field loops below mutate validators without journaling; a bound
+    # device mirror must re-gather on its next sync
+    from ..epoch_engine import invalidate_registry_journal
+
+    invalidate_registry_journal(state)
     cols = _Cols(state)
     process_justification_and_finalization_phase0(spec, state, cols)
     process_rewards_and_penalties_phase0(spec, state, cols)
@@ -322,13 +334,12 @@ def process_registry_updates(spec, state, cols: _Cols):
 
 
 def process_slashings(spec, state, cols: _Cols):
+    from ..types.spec import proportional_slashing_multiplier_for
+
     cur = get_current_epoch(spec, state)
     total = get_total_active_balance(spec, state)
     fork = getattr(state, "fork_name", "phase0")
-    mult = {
-        "phase0": spec.proportional_slashing_multiplier,
-        "altair": spec.proportional_slashing_multiplier_altair,
-    }.get(fork, spec.proportional_slashing_multiplier_bellatrix)
+    mult = proportional_slashing_multiplier_for(spec, fork)
     slash_sum = int(np.asarray(state.slashings, dtype=np.uint64).sum())
     adjusted = min(slash_sum * mult, total)
     target_wd = np.uint64(cur + spec.preset.EPOCHS_PER_SLASHINGS_VECTOR // 2)
@@ -441,6 +452,9 @@ def _participation_cols(state):
 
 
 def _process_epoch_altair(spec: ChainSpec, state) -> None:
+    from ..epoch_engine import invalidate_registry_journal
+
+    invalidate_registry_journal(state)
     cols = _Cols(state)
     process_justification_and_finalization_altair(spec, state, cols)
     process_inactivity_updates(spec, state, cols)

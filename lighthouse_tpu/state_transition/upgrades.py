@@ -219,3 +219,8 @@ def apply_fork_upgrades(spec: ChainSpec, state) -> None:
             == _FORK_RANK[fork] - 1
         ):
             fn(spec, state)
+            # upgrades mutate registry fields (and the kernel fork family)
+            # without journaling — force a full mirror re-gather
+            from ..epoch_engine import invalidate_registry_journal
+
+            invalidate_registry_journal(state)

@@ -26,6 +26,16 @@ def fork_at_least(fork_name: str, target: str) -> bool:
     return _FORK_RANK[fork_name] >= _FORK_RANK[target]
 
 
+def proportional_slashing_multiplier_for(spec, fork_name: str) -> int:
+    """The fork's proportional slashing multiplier (process_slashings) —
+    shared by the numpy epoch path and the device epoch kernels so a future
+    fork's change cannot silently diverge the two."""
+    return {
+        "phase0": spec.proportional_slashing_multiplier,
+        "altair": spec.proportional_slashing_multiplier_altair,
+    }.get(fork_name, spec.proportional_slashing_multiplier_bellatrix)
+
+
 @dataclass(frozen=True)
 class Preset:
     """Compile-time constants (eth_spec.rs trait consts)."""

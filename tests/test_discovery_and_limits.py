@@ -73,6 +73,131 @@ def test_enr_sign_verify_roundtrip():
         d.stop()
 
 
+def _fake_enr_at_distance(local_id, d, fork, start=0, udp=1):
+    """Craft an unsigned ENR whose node id lands in bucket ``d`` of
+    ``local_id`` (direct-table injection; _admit is bypassed in tests that
+    use these). udp=1 is a dead port: PINGs to it are never answered."""
+    import hashlib
+
+    i = start
+    while True:
+        pk = i.to_bytes(48, "big")
+        nid = hashlib.sha256(pk).digest()
+        if nid != local_id and log_distance(local_id, nid) == d:
+            return ENR(1, fork, "127.0.0.1", 0, udp, pk), i
+        i += 1
+
+
+def _service_at_distance(local_id, d, fork, **kw):
+    """Spin up DiscoveryServices until one's node id lands in bucket ``d``
+    (d=256 covers half the id space: a couple of tries)."""
+    for _ in range(64):
+        svc = DiscoveryService(fork_digest=fork, **kw)
+        if log_distance(local_id, svc.enr.node_id) == d:
+            return svc
+        svc.stop()
+    raise AssertionError(f"no service landed in bucket {d}")
+
+
+def test_full_bucket_keeps_dead_oldest_out_liveness_evicts():
+    """Liveness-checked eviction, failure path: a full bucket's oldest is
+    PINGed and, silent past the deadline, evicted for the live candidate
+    (discovery.py pending-eviction machinery; ROADMAP discv5 hardening)."""
+    from lighthouse_tpu.network.discovery import K_BUCKET
+
+    fork = b"\x0a\x0a\x0a\x0a"
+    a = DiscoveryService(fork_digest=fork).start()
+    b = None
+    try:
+        # 16 dead records in bucket 256, injected directly (head = oldest)
+        start = 0
+        dead_ids = []
+        for _ in range(K_BUCKET):
+            enr, start = _fake_enr_at_distance(
+                a.enr.node_id, 256, fork, start=start
+            )
+            start += 1
+            assert a.table.admit(enr)
+            dead_ids.append(enr.node_id)
+        assert len(a.table.at_distance(256)) == K_BUCKET
+        # a live candidate in the same bucket announces itself
+        b = _service_at_distance(a.enr.node_id, 256, fork, tcp_port=9411).start()
+        b.bootstrap(a.enr)
+        # candidate is NOT admitted immediately (pending liveness check)...
+        ids = lambda: {e.node_id for e in a.table.at_distance(256)}
+        assert _wait_for(lambda: b.enr.node_id in ids(), timeout=8.0), (
+            "live candidate never replaced the dead bucket head"
+        )
+        # ...and exactly the stale head made room for it
+        assert dead_ids[0] not in ids()
+        assert len(a.table.at_distance(256)) == K_BUCKET
+    finally:
+        a.stop()
+        if b is not None:
+            b.stop()
+
+
+def test_full_bucket_keeps_alive_oldest_drops_candidate():
+    """Liveness-checked eviction, survival path: the oldest answers the
+    PING, stays in the table, and the newcomer is dropped — long-lived
+    honest peers cannot be flushed by a stream of fresh ENRs."""
+    from lighthouse_tpu.network.discovery import K_BUCKET
+
+    fork = b"\x0b\x0b\x0b\x0b"
+    a = DiscoveryService(fork_digest=fork).start()
+    c = b = None
+    try:
+        # the LIVE node is admitted first: it is the bucket's oldest record
+        c = _service_at_distance(a.enr.node_id, 256, fork).start()
+        c.bootstrap(a.enr)
+        assert _wait_for(lambda: len(a.table.at_distance(256)) == 1)
+        start = 0
+        for _ in range(K_BUCKET - 1):
+            enr, start = _fake_enr_at_distance(
+                a.enr.node_id, 256, fork, start=start
+            )
+            start += 1
+            assert a.table.admit(enr)
+        assert len(a.table.at_distance(256)) == K_BUCKET
+        b = _service_at_distance(a.enr.node_id, 256, fork).start()
+        b.bootstrap(a.enr)
+        time.sleep(2.5)  # liveness window + slack
+        ids = {e.node_id for e in a.table.at_distance(256)}
+        assert c.enr.node_id in ids, "live oldest was evicted"
+        assert b.enr.node_id not in ids, "candidate admitted over live oldest"
+    finally:
+        a.stop()
+        for svc in (b, c):
+            if svc is not None:
+                svc.stop()
+
+
+def test_boot_enr_rejection_is_logged():
+    import logging
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    lg = logging.getLogger("lighthouse_tpu.discovery")
+    h = _Capture(level=logging.WARNING)
+    lg.addHandler(h)
+    d = DiscoveryService(fork_digest=b"\x01\x01\x01\x01")
+    boot = DiscoveryService(fork_digest=b"\x02\x02\x02\x02")
+    try:
+        assert d.bootstrap(boot.enr) is False
+        msgs = [r.getMessage() for r in records]
+        assert any("boot ENR rejected" in m for m in msgs), msgs
+        kvs = [getattr(r, "kv", {}) for r in records]
+        assert any(kv.get("reason") == "fork digest mismatch" for kv in kvs)
+    finally:
+        lg.removeHandler(h)
+        d.stop()
+        boot.stop()
+
+
 def test_routing_table_distance_buckets():
     local = b"\x00" * 32
     t = RoutingTable(local)

@@ -1,0 +1,382 @@
+"""Device epoch engine: numpy-parity property suite + mirror deltas + mesh.
+
+The contract under test (lighthouse_tpu/epoch_engine/): the fused jitted
+single-pass sweep must match the columnar numpy path in
+``state_transition/per_epoch.py`` FIELD FOR FIELD — balances, participation
+outcomes, justification bits, checkpoints, and every registry column — on
+randomized phase0 and altair states seeded with the awkward validator
+populations (slashed at the slashing-penalty epoch, mid-exit, pending
+activation, activation-eligible, ejectable). ``state.tree_root()`` equality
+is the final word: any divergence anywhere in the state surfaces there.
+
+Runs on the CPU backend in tier-1 (the parity suite IS the CPU-run gate for
+the engine); marked ``kernel`` so the host-only tier can skip the XLA
+compiles. The mesh test reuses conftest's virtual 8-device CPU platform —
+the same machinery test_multichip.py exercises for the BLS kernels.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import epoch_engine
+from lighthouse_tpu.state_transition.genesis import interop_genesis_state
+from lighthouse_tpu.state_transition.per_epoch import process_epoch
+from lighthouse_tpu.types.containers import Checkpoint, for_preset
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, minimal_spec
+
+pytestmark = pytest.mark.kernel  # JAX compile-heavy tier (see pytest.ini)
+
+N_VALIDATORS = 96
+
+
+@pytest.fixture(autouse=True)
+def _restore_backend():
+    prev = epoch_engine.get_backend()
+    yield
+    epoch_engine.set_backend(prev)
+
+
+def _spec(fork: str):
+    if fork == "phase0":
+        return minimal_spec()
+    return minimal_spec(altair_fork_epoch=0)
+
+
+def _pending_attestations(spec, state, rng, epoch):
+    """Committee-consistent PendingAttestations with randomized bits,
+    target/head matching, inclusion delays and proposers."""
+    from lighthouse_tpu.state_transition.beacon_state_util import (
+        get_beacon_committee,
+        get_block_root,
+        get_block_root_at_slot,
+        get_committee_count_per_slot,
+    )
+    from lighthouse_tpu.types.containers import AttestationData
+
+    ns = for_preset(spec.preset.name)
+    p = spec.preset
+    atts = []
+    for slot in range(epoch * p.SLOTS_PER_EPOCH, (epoch + 1) * p.SLOTS_PER_EPOCH):
+        if slot >= state.slot:
+            break
+        for index in range(get_committee_count_per_slot(spec, state, epoch)):
+            committee = get_beacon_committee(spec, state, slot, index)
+            target_root = (
+                get_block_root(spec, state, epoch)
+                if rng.random() < 0.8
+                else rng.bytes(32)
+            )
+            head_root = (
+                get_block_root_at_slot(spec, state, slot)
+                if rng.random() < 0.7
+                else rng.bytes(32)
+            )
+            atts.append(
+                ns.PendingAttestation(
+                    aggregation_bits=rng.random(committee.size) < 0.7,
+                    data=AttestationData(
+                        slot=slot,
+                        index=index,
+                        beacon_block_root=head_root,
+                        source=state.current_justified_checkpoint,
+                        target=Checkpoint(epoch=epoch, root=target_root),
+                    ),
+                    inclusion_delay=int(rng.integers(1, p.SLOTS_PER_EPOCH + 1)),
+                    proposer_index=int(rng.integers(0, len(state.validators))),
+                )
+            )
+    return atts
+
+
+def _random_state(spec, fork: str, seed: int, cur_epoch: int = 4):
+    """A registry with every epoch-processing edge case represented."""
+    rng = np.random.default_rng(seed)
+    state = interop_genesis_state(spec, N_VALIDATORS)
+    p = spec.preset
+    state.slot = (cur_epoch + 1) * p.SLOTS_PER_EPOCH - 1
+    for i in range(p.SLOTS_PER_HISTORICAL_ROOT):
+        state.block_roots[i] = rng.bytes(32)
+    state.balances = rng.integers(24 * 10**9, 40 * 10**9, N_VALIDATORS).astype(
+        np.uint64
+    )
+    fin = int(rng.integers(0, cur_epoch))
+    pj = int(rng.integers(fin, cur_epoch))
+    cj = int(rng.integers(pj, cur_epoch))
+    state.finalized_checkpoint = Checkpoint(epoch=fin, root=rng.bytes(32))
+    state.previous_justified_checkpoint = Checkpoint(epoch=pj, root=rng.bytes(32))
+    state.current_justified_checkpoint = Checkpoint(epoch=cj, root=rng.bytes(32))
+    state.justification_bits = rng.random(4) < 0.5
+    for i in range(p.EPOCHS_PER_SLASHINGS_VECTOR):
+        state.slashings[i] = int(rng.integers(0, 2 * 10**9))
+    for i, v in enumerate(state.validators):
+        r = rng.random()
+        if r < 0.08:  # slashed; half right at the slashing-penalty epoch
+            v.slashed = True
+            v.exit_epoch = cur_epoch + 1 + int(rng.integers(0, 4))
+            v.withdrawable_epoch = (
+                cur_epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2
+                if rng.random() < 0.5
+                else cur_epoch + int(rng.integers(6, 300))
+            )
+        elif r < 0.14:  # voluntarily exiting
+            v.exit_epoch = cur_epoch + int(rng.integers(1, 6))
+            v.withdrawable_epoch = (
+                v.exit_epoch + spec.min_validator_withdrawability_delay
+            )
+        elif r < 0.24:  # pending activation (some queued, some not yet)
+            v.activation_epoch = FAR_FUTURE_EPOCH
+            v.activation_eligibility_epoch = (
+                int(rng.integers(0, cur_epoch + 2))
+                if rng.random() < 0.7
+                else FAR_FUTURE_EPOCH
+            )
+        elif r < 0.32:  # ejectable: active but drained
+            v.effective_balance = int(rng.integers(10, 17)) * 10**9
+        elif r < 0.40:  # fresh deposit awaiting the eligibility flag
+            v.activation_epoch = FAR_FUTURE_EPOCH
+            v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+        elif r < 0.55:  # effective balance out of hysteresis band
+            v.effective_balance = int(rng.integers(20, 32)) * 10**9
+    if fork == "phase0":
+        state.previous_epoch_attestations = _pending_attestations(
+            spec, state, rng, cur_epoch - 1
+        )
+        state.current_epoch_attestations = _pending_attestations(
+            spec, state, rng, cur_epoch
+        )
+    else:
+        state.previous_epoch_participation = rng.integers(
+            0, 8, N_VALIDATORS
+        ).astype(np.uint8)
+        state.current_epoch_participation = rng.integers(
+            0, 8, N_VALIDATORS
+        ).astype(np.uint8)
+        state.inactivity_scores = rng.integers(0, 40, N_VALIDATORS).astype(
+            np.uint64
+        )
+    return state
+
+
+_REG_FIELDS = (
+    "effective_balance",
+    "slashed",
+    "activation_epoch",
+    "exit_epoch",
+    "withdrawable_epoch",
+    "activation_eligibility_epoch",
+)
+
+
+def _assert_field_parity(a, b, fork):
+    np.testing.assert_array_equal(
+        np.asarray(a.balances), np.asarray(b.balances)
+    )
+    for f in _REG_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray([getattr(v, f) for v in a.validators]),
+            np.asarray([getattr(v, f) for v in b.validators]),
+            err_msg=f,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(a.justification_bits, dtype=bool),
+        np.asarray(b.justification_bits, dtype=bool),
+    )
+    for cp in (
+        "previous_justified_checkpoint",
+        "current_justified_checkpoint",
+        "finalized_checkpoint",
+    ):
+        assert getattr(a, cp) == getattr(b, cp), cp
+    if fork != "phase0":
+        np.testing.assert_array_equal(
+            np.asarray(a.inactivity_scores), np.asarray(b.inactivity_scores)
+        )
+    assert a.tree_root() == b.tree_root()
+
+
+def _run_both(spec, state, fork):
+    a, b = state.copy(), state.copy()
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, a)
+    epoch_engine.set_backend("device")
+    assert epoch_engine.maybe_process_epoch_on_device(spec, b), (
+        "device engine refused a supported state"
+    )
+    _assert_field_parity(a, b, fork)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_altair_parity_randomized(seed):
+    spec = _spec("altair")
+    _run_both(spec, _random_state(spec, "altair", seed), "altair")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_phase0_parity_randomized(seed):
+    spec = _spec("phase0")
+    _run_both(spec, _random_state(spec, "phase0", seed), "phase0")
+
+
+def test_altair_parity_under_inactivity_leak():
+    """finality 7 epochs stale: the leak penalties and score dynamics."""
+    spec = _spec("altair")
+    state = _random_state(spec, "altair", 7, cur_epoch=7)
+    state.finalized_checkpoint = Checkpoint(epoch=0, root=b"\x11" * 32)
+    _run_both(spec, state, "altair")
+
+
+def test_phase0_parity_under_inactivity_leak():
+    spec = _spec("phase0")
+    state = _random_state(spec, "phase0", 8, cur_epoch=7)
+    state.finalized_checkpoint = Checkpoint(epoch=0, root=b"\x11" * 32)
+    _run_both(spec, state, "phase0")
+
+
+def test_deneb_family_parity():
+    """The altair kernel family at its far end: bellatrix slashing
+    multiplier, deneb activation-churn cap, capella historical summaries
+    (host tail) — one randomized state through both paths."""
+    spec = minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0,
+        capella_fork_epoch=0, deneb_fork_epoch=0,
+    )
+    state = _random_state(spec, "altair", 42)
+    assert state.fork_name == "deneb"
+    _run_both(spec, state, "deneb")
+
+
+def test_genesis_epoch_boundary_parity():
+    """cur_epoch == 1: justification skipped, rewards run — the gate logic
+    inside the fused kernel, not host control flow."""
+    spec = _spec("altair")
+    state = _random_state(spec, "altair", 3, cur_epoch=1)
+    _run_both(spec, state, "altair")
+
+
+# ---------------------------------------------------------------------------
+# Registry mirror: persistence + block-level delta updates
+# ---------------------------------------------------------------------------
+
+
+def test_mirror_delta_update_across_epochs():
+    """The mirror must survive epochs device-resident: one full gather at
+    bind, then journal-delta scatters only for the validators block
+    processing touched — with results identical to numpy-from-scratch."""
+    from lighthouse_tpu.state_transition.common import initiate_validator_exit
+
+    spec = _spec("altair")
+    state = _random_state(spec, "altair", 11)
+    twin = state.copy()
+    p = spec.preset
+
+    epoch_engine.set_backend("device")
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+    state.slot += p.SLOTS_PER_EPOCH
+    # block-level mutation between epochs: an exit, journaled by index
+    initiate_validator_exit(spec, state, 17)
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+
+    stats = epoch_engine.engine_stats(state)
+    assert stats["full_syncs"] == 1, stats
+    assert stats["delta_syncs"] == 1, stats
+    assert stats["dirty_rows"] >= 1, stats
+
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, twin)
+    twin.slot += p.SLOTS_PER_EPOCH
+    initiate_validator_exit(spec, twin, 17)
+    process_epoch(spec, twin)
+    _assert_field_parity(twin, state, "altair")
+
+
+def test_numpy_path_invalidates_journal():
+    """Mixed-backend safety: a numpy epoch on a mirrored state mutates
+    validators without journaling, so the next device sync must re-gather."""
+    spec = _spec("altair")
+    state = _random_state(spec, "altair", 13)
+    epoch_engine.set_backend("device")
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+    state.slot += spec.preset.SLOTS_PER_EPOCH
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, state)
+    state.slot += spec.preset.SLOTS_PER_EPOCH
+    epoch_engine.set_backend("device")
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+    stats = epoch_engine.engine_stats(state)
+    assert stats["full_syncs"] == 2, stats  # bind + post-numpy re-gather
+
+
+def test_registry_growth_regrows_mirror():
+    """Deposits appended between epochs extend the mirror without rebinding."""
+    from lighthouse_tpu.types.containers import Validator
+
+    spec = _spec("altair")
+    state = _random_state(spec, "altair", 17)
+    twin = state.copy()
+    p = spec.preset
+
+    def deposit(s):
+        s.validators = list(s.validators) + [
+            Validator(
+                pubkey=b"\xaa" * 48,
+                withdrawal_credentials=b"\x00" * 32,
+                effective_balance=32 * 10**9,
+                slashed=False,
+                activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+                activation_epoch=FAR_FUTURE_EPOCH,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        ]
+        epoch_engine.mark_registry_delta(s, len(s.validators) - 1)
+        s.balances = np.concatenate(
+            [np.asarray(s.balances, np.uint64), [np.uint64(32 * 10**9)]]
+        )
+        s.previous_epoch_participation = np.concatenate(
+            [np.asarray(s.previous_epoch_participation, np.uint8), [0]]
+        )
+        s.current_epoch_participation = np.concatenate(
+            [np.asarray(s.current_epoch_participation, np.uint8), [0]]
+        )
+        s.inactivity_scores = np.concatenate(
+            [np.asarray(s.inactivity_scores, np.uint64), [0]]
+        )
+
+    epoch_engine.set_backend("device")
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+    state.slot += p.SLOTS_PER_EPOCH
+    deposit(state)
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, twin)
+    twin.slot += p.SLOTS_PER_EPOCH
+    deposit(twin)
+    process_epoch(spec, twin)
+    _assert_field_parity(twin, state, "altair")
+
+
+# ---------------------------------------------------------------------------
+# Sharded over the virtual 8-device mesh (same machinery as test_multichip)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_sweep_matches_numpy():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from lighthouse_tpu.epoch_engine.engine import process_epoch_on_device
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must expose 8 virtual CPU devices"
+    mesh = Mesh(np.array(devs[:8]), axis_names=("validators",))
+    sharding = NamedSharding(mesh, PartitionSpec("validators"))
+
+    spec = _spec("altair")
+    state = _random_state(spec, "altair", 23)
+    twin = state.copy()
+    epoch_engine.set_backend("device")
+    assert process_epoch_on_device(spec, state, sharding=sharding)
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, twin)
+    _assert_field_parity(twin, state, "altair")

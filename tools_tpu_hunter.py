@@ -43,6 +43,7 @@ LOG = os.path.join(ROOT, "TPU_WINDOW_LOG.jsonl")
 STATE = os.path.join(CACHE, "hunter_state.json")
 RECORD = os.path.join(CACHE, "tpu_record.json")
 RECORD_FIREHOSE = os.path.join(CACHE, "tpu_firehose_record.json")
+RECORD_EPOCH = os.path.join(CACHE, "tpu_epoch_record.json")
 RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
@@ -51,7 +52,13 @@ PROBE_TIMEOUT_S = float(os.environ.get("HUNTER_PROBE_TIMEOUT", "120"))
 # bench._LADDER reversed: smallest first — land ANY TPU record, then climb.
 # Timeouts get +50% slack over bench's (a window may open mid-compile).
 # The firehose streaming rung (BASELINE.json config #5) slots in right after
-# the smallest headline rung: one TPU window can capture BOTH metrics.
+# the smallest headline rung: one TPU window can capture BOTH metrics. The
+# epoch-engine rung (BASELINE config #4, epoch_validators_per_s) follows at
+# its 32k size — its kernel is tiny next to the BLS programs, so it stays
+# compile-warm in .jax_cache and spends the window measuring; the 1M-
+# validator stretch rung caps the ladder. Every rung start is gated on
+# bench_main_in_progress() in main(), so a concurrent bench.py probe+ladder
+# phase (the flock marker) is never raced for the device.
 RUNGS = [
     (sets, keys, validators, batch, timeout * 1.5, "sets")
     for sets, keys, validators, batch, timeout in reversed(bench._LADDER)
@@ -62,6 +69,8 @@ RUNGS.insert(
     + (bench._FIREHOSE_RUNG[4] * 1.5,)
     + bench._FIREHOSE_RUNG[5:],
 )
+RUNGS.insert(2, bench._EPOCH_RUNG_SMALL)
+RUNGS.append(bench._EPOCH_RUNG_FULL)
 
 
 def log(event: str, **kw) -> None:
@@ -134,13 +143,13 @@ def persist(rec: dict, rung_idx: int) -> None:
     os.makedirs(CACHE, exist_ok=True)
     with open(RECORDS, "a") as f:
         f.write(json.dumps(rec) + "\n")
-    # firehose records live in their own best-record file (different metric;
-    # bench.py --firehose emits it when the end-of-round tunnel is wedged)
-    record_path = (
-        RECORD_FIREHOSE
-        if rec.get("metric") == "firehose_attestations_verified_per_s"
-        else RECORD
-    )
+    # firehose/epoch records live in their own best-record files (different
+    # metrics; bench.py --firehose/--epoch emit them when the end-of-round
+    # tunnel is wedged)
+    record_path = {
+        "firehose_attestations_verified_per_s": RECORD_FIREHOSE,
+        "epoch_validators_per_s": RECORD_EPOCH,
+    }.get(rec.get("metric"), RECORD)
     best = None
     try:
         with open(record_path) as f:
