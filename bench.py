@@ -682,6 +682,83 @@ def _inner_firehose():
     )
 
 
+def _inner_h2c():
+    """h2c micro-rung: isolated hash-to-curve cost so scalar-chain work is
+    measurable without a full firehose run. Reports h2c_points_per_s for the
+    fused device map plus per-stage ms (host hashing, sswu fraction map,
+    isogeny, cofactor clearing) at the gossip batch shape; parity against
+    the Python oracle is asserted on the first message — the rung verifies
+    while it measures."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    if fallback:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops.bls import curve, g2, h2c
+    from lighthouse_tpu.ops.bls_oracle import hash_to_curve as oh
+    from lighthouse_tpu.ops.bls_oracle.ciphersuite import DST
+
+    n = BATCH
+    iters = int(os.environ.get("BENCH_H2C_ITERS", "3"))
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0x42C)
+    msgs = [rng.bytes(32) for _ in range(n)]
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+        u0, u1 = h2c.hash_to_field_batch(msgs, DST)
+    host_ms = (time.perf_counter() - t0) / 3 * 1e3
+
+    map_fn = jax.jit(h2c.map_to_g2)
+    t0 = time.perf_counter()
+    pts = map_fn(u0, u1)
+    jax.block_until_ready(pts)
+    print(
+        f"# h2c warmup (compile) {time.perf_counter() - t0:.0f}s on {platform}",
+        flush=True,
+    )
+    assert g2.to_oracle(pts[0]) == oh.hash_to_curve_g2(msgs[0], DST), (
+        "device h2c diverged from the oracle"
+    )
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pts = map_fn(u0, u1)
+    jax.block_until_ready(pts)
+    dt = time.perf_counter() - t0
+    map_ms = dt / iters * 1e3
+
+    u = jnp.stack([u0, u1], axis=0)
+    sswu_fn = jax.jit(h2c.map_to_curve_sswu_fraction)
+    stages = {"host_hash_to_field": host_ms}
+    stages["sswu"] = _time_stage(sswu_fn, u)
+    frac = sswu_fn(u)
+    iso_fn = jax.jit(h2c.iso_map_fraction)
+    stages["iso"] = _time_stage(iso_fn, *frac)
+    q = iso_fn(*frac)
+    qq = jax.jit(lambda q: curve.point_add(2, q[0], q[1]))(q)
+    stages["cofactor"] = _time_stage(jax.jit(h2c.clear_cofactor), qq)
+    stages["map_total"] = map_ms
+    print(
+        json.dumps(
+            {
+                "metric": "h2c_points_per_s",
+                "value": round(n * iters / dt, 2),
+                "unit": "points/s",
+                "platform": platform,
+                "fallback": fallback,
+                "shape": {"batch": n},
+                "stages_ms_per_batch": {
+                    k: round(v, 2) for k, v in stages.items()
+                },
+            }
+        )
+    )
+
+
 def _build_epoch_state(spec, n: int, rng):
     """Synthetic mainnet-preset altair state with ``n`` validators for the
     epoch-replay rung (BASELINE config #4). Dummy pubkeys: epoch processing
@@ -866,6 +943,12 @@ _EPOCH_LADDER = [
 _EPOCH_RUNG_SMALL = (0, 0, 32768, 0, 1350.0, "epoch")
 _EPOCH_RUNG_FULL = (0, 0, 1048576, 0, 4050.0, "epoch")
 
+# h2c micro-rung (the scalar-chain stage in isolation): only `batch`
+# matters. The small batch is the gossip shape; its program is tiny next to
+# the full verify kernels, so it stays compile-warm in .jax_cache and a
+# short TPU window spends its time measuring.
+_H2C_RUNG_SMALL = (0, 0, 0, 8, 1350.0, "h2c")
+
 
 def git_head() -> str:
     """Current repo HEAD (short), best-effort. Shared with the hunter so
@@ -892,6 +975,7 @@ def _hunter_record(mode: str = "sets") -> dict | None:
     name = {
         "firehose": "tpu_firehose_record.json",
         "epoch": "tpu_epoch_record.json",
+        "h2c": "tpu_h2c_record.json",
     }.get(mode, "tpu_record.json")
     path = os.path.join(_CACHE_DIR, name)
     try:
@@ -956,12 +1040,16 @@ def main():
         mode = "firehose"
     elif "--epoch" in sys.argv:
         mode = "epoch"
+    elif "--h2c" in sys.argv:
+        mode = "h2c"
     if "--inner" in sys.argv:
         inner_mode = os.environ.get("BENCH_MODE", mode)
         if inner_mode == "firehose":
             _inner_firehose()
         elif inner_mode == "epoch":
             _inner_epoch()
+        elif inner_mode == "h2c":
+            _inner_h2c()
         else:
             _inner()
         return
@@ -1003,6 +1091,10 @@ def _main_measure(mode: str) -> None:
             # batch path is orders of magnitude slower on CPU; the engine
             # shedding most of a 50k/s offer is the honest record)
             ladder = [(128, 1, 2048, 16, 1800.0)]
+    elif mode == "h2c":
+        ladder = [(0, 0, 0, BATCH, 900.0)]
+        if fallback:
+            ladder = [(0, 0, 0, 8, 900.0)]
     elif mode == "epoch":
         # (validators, timeout) → run_inner's (sets, keys, validators,
         # batch, timeout) plumbing; on a wedged tunnel only the CPU-sized
@@ -1045,6 +1137,7 @@ def _main_measure(mode: str) -> None:
     metric = {
         "firehose": "firehose_attestations_verified_per_s",
         "epoch": "epoch_validators_per_s",
+        "h2c": "h2c_points_per_s",
     }.get(mode, "bls_attestation_sets_verified_per_s")
     print(
         json.dumps(
@@ -1052,7 +1145,8 @@ def _main_measure(mode: str) -> None:
                 "metric": metric,
                 "value": 0.0,
                 "unit": {
-                    "firehose": "att/s", "epoch": "validators/s"
+                    "firehose": "att/s", "epoch": "validators/s",
+                    "h2c": "points/s",
                 }.get(mode, "sets/s"),
                 "vs_baseline": 0.0,
                 "platform": platform,

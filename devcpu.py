@@ -39,8 +39,21 @@ def force_cpu_mesh(n_devices: int = _DEFAULT_DEVICES):
 
         xla_bridge._clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
-    return jax.devices("cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # older jax (< 0.5) has no jax_num_cpu_devices config; the fresh CPU
+        # client created after clear_backends reads the
+        # --xla_force_host_platform_device_count flag _set_env just wrote
+        pass
+    devs = jax.devices("cpu")
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"CPU mesh has {len(devs)} devices, wanted {n_devices} — this "
+            "jax build honors neither jax_num_cpu_devices nor a post-init "
+            "XLA_FLAGS change"
+        )
+    return devs
 
 
 # import side effect: claim the platform before any JAX client exists

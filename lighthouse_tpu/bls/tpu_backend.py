@@ -30,6 +30,28 @@ from ..ops.bls_oracle import curves as _oc
 
 RAND_BITS = 64  # blst.rs:16
 
+
+def _shard_map():
+    """shard_map across jax versions: top-level (newer jax exports
+    ``jax.shard_map``) with the experimental namespace as the fallback —
+    older builds raise ImportError from ``from jax import shard_map`` and
+    used to FAIL the sharded tests instead of running them. Those older
+    builds also lack a replication rule for ``while`` (the Miller loop's
+    fori/scan), so the wrapper passes ``check_rep=False`` where the kwarg
+    exists (its documented workaround) and drops it where it doesn't."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:  # pragma: no cover - version-dependent
+        from jax.experimental.shard_map import shard_map as sm
+
+    def wrapped(f, **kw):
+        try:
+            return sm(f, check_rep=False, **kw)
+        except TypeError:  # pragma: no cover - newer jax: kwarg removed
+            return sm(f, **kw)
+
+    return wrapped
+
 _MINUS_G1 = _oc.g1_neg(_oc.g1_generator())
 _MG1_X = fq.from_int(_MINUS_G1[0])
 _MG1_Y = fq.from_int(_MINUS_G1[1])
@@ -59,11 +81,24 @@ def _set_prologue(pk_agg, sig, scalars, valid):
     The security-critical prologue shared verbatim by the single-chip and
     sharded kernels: G2 subgroup check (blst.rs:75-78), infinity rejection,
     random-scalar scaling of pubkeys and signatures, and the masked G2 sum.
-    """
-    sig_grp = g2.subgroup_check(sig)
+
+    The two G2 chains — the subgroup check's |x|-chain (psi(Q) == [x]Q) and
+    the Fiat–Shamir random scaling [r]Q — multiply the SAME point, so they
+    run as one fused windowed pass (curve.scale_u64_with_fixed): one
+    precomputed multiples table, one doubling ladder, every kernel dispatch
+    covering both chains. The G1 pubkey scaling is the same windowed ladder
+    at k = 1."""
+    from ..ops.bls_oracle.fields import BLS_X
+
+    accs = curve.scale_u64_with_fixed(2, sig, scalars, (-BLS_X,))
+    sig_scaled, abs_x_sig = accs[0], accs[1]
+    # psi(Q) == [x]Q with x < 0: [x]Q = -[|x|]Q
+    sig_grp = curve.point_eq(
+        2, g2.psi(sig), curve.point_neg(2, abs_x_sig)
+    )
     set_ok = ~valid | (sig_grp & ~g1.is_inf(pk_agg) & ~g2.is_inf(sig))
     pk_scaled = g1.scale_u64(pk_agg, scalars)
-    sig_sum = g2.psum(g2.scale_u64(sig, scalars), valid)
+    sig_sum = g2.psum(sig_scaled, valid)
     return set_ok, pk_scaled, sig_sum
 
 
@@ -341,7 +376,7 @@ def verify_indexed_sets_device(cache_arr, items) -> bool:
 def _sharded_h2c_stage(mesh, n_pad: int):
     """Sharded twin of ``_h2c_stage``: SSWU/isogeny/cofactor/affine on each
     device's local slice of the sets axis (purely local — no collectives)."""
-    from jax import shard_map
+    shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     from ..ops.bls import h2c
@@ -362,7 +397,7 @@ def _sharded_prep_stage(mesh, n_pad: int, k_pad: int):
     parity; ~100 MB at 1M validators, well within HBM); each device
     decompresses, gathers, and aggregates only its n/n_dev sets and emits
     per-device G2 signature partial sums + a per-device set_ok verdict."""
-    from jax import shard_map
+    shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     from .serde import raw_to_mont
@@ -389,7 +424,7 @@ def _sharded_prep_stage(mesh, n_pad: int, k_pad: int):
 @functools.lru_cache(maxsize=None)
 def _sharded_array_prologue_stage(mesh, n_pad: int):
     """Sharded twin of ``_prologue_stage`` (pre-aggregated pk/sig arrays)."""
-    from jax import shard_map
+    shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     def local(pk_agg, sig, scalars, valid):
@@ -407,7 +442,7 @@ def _sharded_array_prologue_stage(mesh, n_pad: int):
 def _sharded_miller_stage(mesh, n_pad: int):
     """Per-device Miller loops over the local sets plus the local Fq12
     product — one [n_dev, 12, 25] partial per device."""
-    from jax import shard_map
+    shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     def local(pkx, pky, mxa, mya, valid):

@@ -1,0 +1,326 @@
+"""Fixed-scalar plan compiler: windowed/wNAF chain schedules for host-known
+scalars and exponents, executed as ONE ``lax.scan`` per call site.
+
+The scalar-mul analogue of the lincomb ``Plan`` machinery in ``plans.py``:
+where a ``Plan`` flattens one tower *multiplication* into a single stacked
+kernel, a ``ChainSchedule`` flattens a whole *scalar multiplication* (or a
+fixed-exponent power) into a static schedule of shared-doubling runs plus
+table-referencing add steps, compiled at trace time from the host-known
+scalars:
+
+  * ``compile_chains([e_0, .., e_C-1])`` recodes each scalar (plain binary,
+    NAF, or width-w wNAF — a cost model picks the cheapest; sparse scalars
+    like the BLS parameter |x| stay on the binary schedule, dense ones get a
+    window) and merges the C chains onto ONE position-aligned segment list:
+    every dbl/sqr kernel dispatch covers all chains at once.
+  * ``run_point_chains`` executes the schedule on stacked curve points
+    ([C, *batch, 3k, 25]) — odd-multiple tables built jointly, signs applied
+    by a branchless negate-select (complete formulas make the infinity slot
+    of a zero digit a no-op), body emitted as one scan over (run, digit)
+    segments.
+  * ``run_field_chains`` executes the same schedule shape in a multiplicative
+    group (sqr/mul callbacks) with per-chain exponents — the h2c prep chains
+    (sqrt-ratio / inversion exponents) run as one joint scan with
+    lazy-bounded interiors (plans.CHAIN_BOUND) and a single trailing
+    normalization.
+
+Scalars may be negative (point chains negate branchlessly at the end) or
+zero (the schedule degenerates to the identity/infinity). Windows are chosen
+per call site by ``_schedule_cost`` unless forced.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------------------
+# Host-side recoding
+# --------------------------------------------------------------------------------------
+
+
+def wnaf_digits(e: int, w: int) -> list[int]:
+    """LSB-first width-w NAF: nonzero digits are odd, |d| < 2^(w-1), and any
+    two nonzero digits are >= w positions apart. w = 1 gives plain binary
+    (digits 0/1); w = 2 gives classic NAF."""
+    assert e >= 0
+    if w == 1:
+        return [int(b) for b in bin(e)[2:][::-1]] if e else [0]
+    out = []
+    while e:
+        if e & 1:
+            d = e & ((1 << w) - 1)
+            if d >= 1 << (w - 1):
+                d -= 1 << w
+            out.append(d)
+            e -= d
+        else:
+            out.append(0)
+        e >>= 1
+    return out or [0]
+
+
+class ChainSchedule:
+    """Joint MSB-first schedule for C chains sharing doubling runs.
+
+    segments: list of (run, digits) — ``run`` doublings (squarings), then one
+    add (multiply) step consuming per-chain signed digit ``digits[c]`` (0 =
+    no-op via the identity table slot). The leading segment has run = 0 and
+    initializes the accumulators from the table directly.
+    table_max: largest |digit| across chains — the joint table holds the
+    multiples {identity, 1, 3, .., table_max} (odd only for signed schedules,
+    every value for unsigned ones).
+    """
+
+    __slots__ = ("segments", "n_chains", "table_max", "signed", "negate")
+
+    def __init__(self, segments, n_chains, table_max, signed, negate):
+        self.segments = segments
+        self.n_chains = n_chains
+        self.table_max = table_max
+        self.signed = signed
+        self.negate = negate  # per-chain final negation (negative scalars)
+
+    @property
+    def n_doublings(self) -> int:
+        return sum(r for r, _ in self.segments)
+
+    @property
+    def n_adds(self) -> int:
+        return len(self.segments)
+
+    def table_slots(self) -> list[int]:
+        """Multiples materialized in the table, identity first."""
+        if self.signed:
+            return [0] + list(range(1, self.table_max + 1, 2))
+        return list(range(self.table_max + 1))
+
+    def slot_index(self, d: int) -> int:
+        """Table slot of |digit| d."""
+        if self.signed:
+            return 0 if d == 0 else (abs(d) + 1) // 2
+        return d
+
+
+def _merge_digit_columns(digit_rows: list[list[int]]):
+    """Per-chain LSB-first digit lists -> MSB-first merged (run, column)
+    segments. A column is emitted wherever ANY chain has a nonzero digit."""
+    n = max(len(r) for r in digit_rows)
+    cols = []
+    for i in range(n - 1, -1, -1):  # MSB first
+        col = tuple(r[i] if i < len(r) else 0 for r in digit_rows)
+        cols.append(col)
+    segments = []
+    run = 0
+    started = False
+    for col in cols:
+        if any(col):
+            segments.append((run if started else 0, col))
+            run = 1
+            started = True
+        else:
+            run += 1
+    if not started:
+        return [(0, tuple(0 for _ in digit_rows))]
+    # trailing zero columns: pure doublings with a no-op digit column
+    if run > 1:
+        segments.append((run - 1, tuple(0 for _ in digit_rows)))
+    return segments
+
+
+def _schedule_cost(schedule: ChainSchedule, dbl_cost=1.0, add_cost=1.2) -> float:
+    """Rough op-count model: doubling runs + add steps + table build."""
+    slots = len(schedule.table_slots())
+    return (
+        schedule.n_doublings * dbl_cost
+        + schedule.n_adds * add_cost
+        + max(0, slots - 2) * add_cost
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def compile_chains(
+    scalars: tuple, window: int | None = None, signed: bool = True
+) -> ChainSchedule:
+    """Compile host-known scalars into the cheapest joint schedule.
+
+    signed=True allows wNAF recoding (group inverses are cheap for curve
+    points); signed=False restricts to unsigned windows (field chains, where
+    inversion is a whole Fermat chain). With window=None the cost model
+    scans w in 1..6 and keeps the cheapest — sparse scalars (|x|, u^2) stay
+    binary, dense ones (sqrt-ratio exponents) get a window.
+    """
+    mags = [abs(int(e)) for e in scalars]
+    negate = tuple(e < 0 for e in scalars)
+
+    def build(w: int) -> ChainSchedule:
+        if signed and w > 1:
+            rows = [wnaf_digits(e, w) for e in mags]
+            table_max = max(
+                [1] + [max((abs(d) for d in r), default=0) for r in rows]
+            )
+            return ChainSchedule(
+                _merge_digit_columns(rows), len(mags), table_max, True, negate
+            )
+        # unsigned fixed window (w=1: binary)
+        rows = []
+        for e in mags:
+            r = []
+            while True:
+                r.append(e & ((1 << w) - 1))
+                e >>= w
+                if not e:
+                    break
+            rows.append(r)
+        table_max = max(max(r) for r in rows)
+        segs = _merge_digit_columns(rows)
+        # each unsigned-window column step costs w doublings, not 1
+        segs = [(r * w, col) for r, col in segs]
+        # the leading segment initializes from the table (no doublings)
+        segs[0] = (0, segs[0][1])
+        return ChainSchedule(segs, len(mags), table_max, False, negate)
+
+    candidates = [build(w) for w in ((window,) if window else range(1, 7))]
+    return min(candidates, key=_schedule_cost)
+
+
+# --------------------------------------------------------------------------------------
+# Executors
+# --------------------------------------------------------------------------------------
+
+
+def _segment_arrays(schedule: ChainSchedule):
+    runs = jnp.asarray([r for r, _ in schedule.segments], dtype=jnp.int32)
+    idx = jnp.asarray(
+        [[schedule.slot_index(d) for d in col] for _, col in schedule.segments],
+        dtype=jnp.int32,
+    )
+    sign = jnp.asarray(
+        [[d < 0 for d in col] for _, col in schedule.segments], dtype=bool
+    )
+    return runs, idx, sign
+
+
+def run_point_chains(k: int, points, schedule: ChainSchedule):
+    """Execute a compiled schedule on stacked points [C, *batch, 3k, 25]
+    (C = schedule.n_chains); returns the per-chain products, same shape.
+    One joint odd-multiple table, one lax.scan — every point_dbl/point_add
+    dispatch covers all C chains."""
+    from . import curve
+
+    assert points.shape[0] == schedule.n_chains
+    inf = jnp.broadcast_to(curve.inf_point(k), points.shape)
+    # derive from `points` so the scan carry's device-varying type matches
+    # under shard_map (see curve.scale_bits)
+    inf = points * jnp.uint64(0) + inf
+    slots = schedule.table_slots()
+    entries = {0: inf, 1: points}
+    if schedule.signed:
+        step2 = curve.point_dbl(k, points) if schedule.table_max > 1 else None
+        for s in slots[2:]:
+            entries[s] = curve.point_add(k, entries[s - 2], step2)
+    else:
+        for s in slots[2:]:
+            entries[s] = curve.point_add(k, entries[s - 1], points)
+    table = jnp.stack([entries[s] for s in slots], axis=0)  # [S, C, *batch, ..]
+
+    runs, idx, sign = _segment_arrays(schedule)
+    bshape = points.shape[1:-2]
+
+    def gather(i, s):
+        ii = i.reshape((1,) + i.shape + (1,) * (len(bshape) + 2))
+        ent = jnp.take_along_axis(table, ii, axis=0)[0]
+        neg = curve.point_neg(k, ent)
+        return curve.point_select(
+            jnp.broadcast_to(
+                s.reshape(s.shape + (1,) * len(bshape)), ent.shape[:-2]
+            ),
+            neg,
+            ent,
+        )
+
+    def seg_body(acc, xs):
+        run, i, s = xs
+        acc = jax.lax.fori_loop(
+            0, run, lambda _, a: curve.point_dbl(k, a), acc
+        )
+        return curve.point_add(k, acc, gather(i, s)), None
+
+    # leading segment (run = 0) initializes the accumulator from the table
+    (_, i0, s0) = (schedule.segments[0][0], idx[0], sign[0])
+    acc = gather(i0, s0)
+    acc, _ = jax.lax.scan(seg_body, acc, (runs[1:], idx[1:], sign[1:]))
+    if any(schedule.negate):
+        negm = jnp.asarray(schedule.negate).reshape(
+            (schedule.n_chains,) + (1,) * len(bshape)
+        )
+        acc = curve.point_select(
+            jnp.broadcast_to(negm, acc.shape[:-2]),
+            curve.point_neg(k, acc),
+            acc,
+        )
+    return acc
+
+
+def scale_fixed_chain(k: int, point, e: int, window: int | None = None):
+    """Single-chain convenience: [e] * point via the plan compiler (the
+    curve.scale_fixed replacement). Handles e < 0 and e == 0."""
+    if e == 0:
+        from . import curve
+
+        return jnp.broadcast_to(curve.inf_point(k), point.shape)
+    return run_point_chains(k, point[None], compile_chains((e,), window))[0]
+
+
+def run_field_chains(
+    schedule: ChainSchedule,
+    bases,
+    sqr_fn,
+    mul_fn,
+    one_arr,
+    mul_many_fn=None,
+):
+    """Execute an (unsigned) schedule in a multiplicative group.
+
+    bases: [C, *batch, k, 25] stacked chain bases; returns per-chain powers
+    [C, *batch, k, 25]. sqr_fn/mul_fn operate on stacked arrays and may run
+    at lazy interior bounds — callers normalize the result. The table is
+    built with a log-depth ladder: level d computes entries 2^(d-1)+1 .. 2^d
+    as ONE stacked multiply (mul_many_fn(x, y) defaults to mul_fn)."""
+    assert not schedule.signed and not any(schedule.negate)
+    mul_many_fn = mul_many_fn or mul_fn
+    slots = schedule.table_slots()
+    n_slots = len(slots)
+    one = jnp.broadcast_to(one_arr, bases.shape) + bases * jnp.uint64(0)
+    entries = [one, bases]
+    while len(entries) < n_slots:
+        # T_j = base^j built 0..L-1; extend with T_{L-1} * T_{1..take} — one
+        # stacked multiply doubles the table per level (log-depth build)
+        take = min(len(entries) - 1, n_slots - len(entries))
+        lhs = jnp.broadcast_to(
+            entries[-1][None], (take,) + entries[-1].shape
+        )
+        rhs = jnp.stack(entries[1 : take + 1], axis=0)
+        prod = mul_many_fn(lhs, rhs)
+        for j in range(take):
+            entries.append(prod[j])
+    table = jnp.stack(entries, axis=0)  # [S, C, *batch, k, 25]
+
+    runs, idx, _ = _segment_arrays(schedule)
+    bshape = bases.shape[1:-2]
+
+    def gather(i):
+        ii = i.reshape((1,) + i.shape + (1,) * (len(bshape) + 2))
+        return jnp.take_along_axis(table, ii, axis=0)[0]
+
+    def seg_body(acc, xs):
+        run, i = xs
+        acc = jax.lax.fori_loop(0, run, lambda _, a: sqr_fn(a), acc)
+        return mul_fn(acc, gather(i)), None
+
+    acc = gather(idx[0])
+    acc, _ = jax.lax.scan(seg_body, acc, (runs[1:], idx[1:]))
+    return acc

@@ -248,37 +248,72 @@ def scale_bits(k: int, point, bits):
     return acc
 
 
-def scale_u64(k: int, point, scalars, window: int = 2):
+def scale_u64(k: int, point, scalars, window: int = 4):
     """Per-point 64-bit scalar multiply (the batch-verification random-scalar
     path, RAND_BITS = 64 per /root/reference/crypto/bls/src/impls/blst.rs:16).
 
-    2-bit windowed ladder: 32 scan steps of (2 dbl + 1 table add) instead of
-    64 x (dbl + add + select). The per-element digit table lookup is a gather;
-    table[0] is infinity, so digit 0 needs no masking (complete formulas)."""
+    Fixed-window ladder over an on-device precomputed table: 64/w scan steps
+    of (w dbl + 1 table add) — at the default w = 4 that is 16 adds versus
+    the bit ladder's 64 (and the old 2-bit window's 32). The per-element
+    digit table lookup is a gather; table[0] is infinity, so digit 0 needs
+    no masking (complete formulas)."""
+    return scale_u64_with_fixed(k, point, scalars, (), window)[0]
+
+
+def scale_u64_with_fixed(
+    k: int, point, scalars, fixed: tuple = (), window: int = 4
+):
+    """[r]P for device scalars r PLUS [e]P for each host-fixed e — all chains
+    share ONE precomputed multiples table and ONE w-bit windowed scan, so
+    every point_dbl/point_add dispatch covers the random-scalar chain and
+    the fixed chains together (the prologue's subgroup |x|-chain rides the
+    Fiat–Shamir scaling for free). fixed entries must be non-negative and
+    < 2^64. Returns [1 + len(fixed), *batch, 3k, 25]."""
     assert 64 % window == 0, "window must divide the 64-bit scalar width"
+    assert all(0 <= e < 1 << 64 for e in fixed)
     n_ent = 1 << window
-    entries = [
-        point * jnp.uint64(0) + jnp.broadcast_to(inf_point(k), point.shape),
-        point,
-    ]
-    for _ in range(2, n_ent):
-        entries.append(point_add(k, entries[-1], point))
-    table = jnp.stack(entries, axis=0)  # [2^w, *batch, 3k, 25]
+    n_lane = 1 + len(fixed)
+    inf = point * jnp.uint64(0) + jnp.broadcast_to(inf_point(k), point.shape)
+    # incremental multiples as ONE scan (an unrolled build put 2^w - 2
+    # point_add bodies in the top-level program — compile-time creep)
+    def _tab_body(acc, _):
+        nxt = point_add(k, acc, point)
+        return nxt, nxt
+    _, rest = jax.lax.scan(_tab_body, point, None, length=n_ent - 2)
+    table = jnp.concatenate(
+        [inf[None], point[None], rest], axis=0
+    )  # [2^w, *batch, 3k, 25]
     n_dig = 64 // window
     shifts = jnp.arange(n_dig - 1, -1, -1, dtype=jnp.uint64) * jnp.uint64(window)
     digits = (
         scalars[None, ...] >> shifts.reshape((n_dig,) + (1,) * scalars.ndim)
-    ) & jnp.uint64(n_ent - 1)
+    ) & jnp.uint64(n_ent - 1)  # [n_dig, *batch]
+    digits = digits[:, None]  # lane axis
+    if fixed:
+        fx = np.array(
+            [
+                [(e >> (window * (n_dig - 1 - i))) & (n_ent - 1) for e in fixed]
+                for i in range(n_dig)
+            ],
+            dtype=np.uint64,
+        )  # [n_dig, F]
+        fx = jnp.broadcast_to(
+            jnp.asarray(fx).reshape((n_dig, len(fixed)) + (1,) * scalars.ndim),
+            (n_dig, len(fixed)) + scalars.shape,
+        )
+        digits = jnp.concatenate([digits, fx], axis=1)  # [n_dig, L, *batch]
 
     def step(acc, digit):
         for _ in range(window):
             acc = point_dbl(k, acc)
         idx = digit.astype(jnp.int32)[None, ..., None, None]
-        sel = jnp.take_along_axis(table, idx, axis=0)[0]
+        sel = jnp.take_along_axis(table[:, None], idx, axis=0)[0]
         return point_add(k, acc, sel), None
 
-    acc0 = point * jnp.uint64(0) + jnp.broadcast_to(
-        inf_point(k), point.shape
+    acc0 = jnp.broadcast_to(
+        point[None] * jnp.uint64(0)
+        + jnp.broadcast_to(inf_point(k), point.shape),
+        (n_lane,) + point.shape,
     )
     acc, _ = jax.lax.scan(step, acc0, digits)
     return acc
@@ -300,34 +335,22 @@ def fixed_schedule(e: int) -> list[tuple[int, int]]:
     return segs
 
 
-def scale_fixed(k: int, point, e: int):
+def scale_fixed(k: int, point, e: int, window: int | None = None):
     """Multiply by a host-fixed scalar (subgroup checks, cofactor clearing).
 
-    The scalar is known at trace time, so zero bits cost ONLY a doubling
-    (63 dbl + 5 add for the BLS parameter |x|, popcount 6, vs the ladder's
-    64 dbl + 64 add + select). The segment schedule runs as ONE lax.scan whose
-    body is a dynamic-count doubling fori_loop plus a masked add — a single
-    compiled (dbl + add) body per call site, where the old host-unrolled
-    segmentation emitted every segment's point ops into the top-level program
-    (~14.5k HLO lines per scale_fixed; compile time was the r3 bottleneck)."""
-    if e < 0:
-        return point_neg(k, scale_fixed(k, point, -e))
-    if e == 0:
-        return jnp.broadcast_to(inf_point(k), point.shape)
-    segs = fixed_schedule(e)
-    if not segs:
-        return point
-    runs = jnp.asarray([r for r, _ in segs], dtype=jnp.int32)
-    adds = jnp.asarray([a for _, a in segs], dtype=jnp.int32)
+    Compiled at trace time by the fixed-scalar plan compiler
+    (chain_plans.compile_chains): the scalar is recoded (binary / NAF /
+    width-w wNAF, cheapest wins by a cost model) into a shared-doubling-run
+    segment schedule with a precomputed odd-multiple table, and emitted as
+    ONE lax.scan whose body is a dynamic-count doubling fori_loop plus one
+    table-gather add — a single compiled (dbl + add) body per call site.
+    For the weight-6 BLS |x| this is 61 dbl + 5 add (wNAF) vs the old plain
+    binary schedule's 63 dbl + 6 add; dense scalars (x^2 - x - 1, u^2) gain
+    far more from the window. Negative and zero scalars are handled in the
+    plan (branchless final negation / the infinity table slot)."""
+    from . import chain_plans
 
-    def seg_body(acc, seg):
-        run, addf = seg
-        acc = jax.lax.fori_loop(0, run, lambda _, a: point_dbl(k, a), acc)
-        added = point_add(k, acc, point)
-        return point_select(addf == 1, added, acc), None
-
-    acc, _ = jax.lax.scan(seg_body, point, (runs, adds))
-    return acc
+    return chain_plans.scale_fixed_chain(k, point, e, window)
 
 
 # --------------------------------------------------------------------------------------

@@ -401,6 +401,40 @@ def _conv_product(a, b):
     return _conv_product_shear(a, b)
 
 
+# Row threshold for keeping the reduction walk in f64 (f64 backend only).
+# Above it the walk is compute-bound and f64 SIMD FMAs (+ the matmul fold)
+# beat the scalarized u64 multiplies (~1.6x on a batch-8 G2 point-double, 80
+# rows); below it the walk is pass-count-bound and the f64 schedule (longer
+# under the 2^53 cap) loses. Static per-call-site dispatch — both paths are
+# exact.
+F64_WALK_MIN_ROWS = 32
+
+
+def _static_rows(a) -> int:
+    n = 1
+    for d in a.shape[:-1]:
+        n *= int(d)
+    return n
+
+
+def _conv_product_keep(a, b):
+    """_conv_product, but on the f64 backend (and at row counts where it
+    wins — F64_WALK_MIN_ROWS) the accumulators STAY f64 so the downstream
+    reduction walk runs in f64 as well. x86 has no vectorized 64-bit integer
+    multiply — the u64 congruence-fold passes scalarize and dominated the
+    execute pipeline (~60% of a point-double); the f64 walk is the same
+    fold schedule (2^53 exactness cap, statically re-derived) on SIMD FMAs.
+    reduce_limbs casts back to u64 at the end."""
+    impl = conv_backend()
+    if impl == "digits":
+        return _conv_product_digits(a, b)
+    if impl == "f64":
+        if max(_static_rows(a), _static_rows(b)) >= F64_WALK_MIN_ROWS:
+            return _conv_product_f64(a, b)
+        return _conv_product_f64_u64(a, b)
+    return _conv_product_shear(a, b)
+
+
 # Congruence-fold rows: _FOLD_ROWS[j] = 16-bit limbs of 2^(16*(25+j)) mod p.
 # Folding limb 25+j through its row is an exact congruence mod p.
 _N_FOLD = 40
@@ -466,15 +500,25 @@ def _carry_round(t, s: _RState):
 
 def _fold_high(t, s: _RState):
     """Fold limbs >= 25 through the 2^(16k) mod p rows — an exact congruence
-    mod p that shrinks the value by ~2^19x per live high limb. Unrolled
-    broadcast-FMA terms (not a .sum(-2) reduction) so XLA fuses the fold into
-    the surrounding elementwise chain — the reduction form materialized the
-    [..., n_hi, 25] intermediate and cost an extra memory pass."""
+    mod p that shrinks the value by ~2^19x per live high limb. On the f64
+    walk the fold is ONE [..., n_hi] x [n_hi, 25] dot_general (SIMD matmul —
+    5x the unrolled FMA chain at chain widths); on integer walks it stays
+    unrolled broadcast-FMA terms (not a .sum(-2) reduction) so XLA fuses the
+    fold into the surrounding elementwise chain — the reduction form
+    materialized the [..., n_hi, 25] intermediate and cost an extra memory
+    pass (and u64 dots scalarize)."""
     n_hi = t.shape[-1] - NLIMBS
-    rows = _FOLD_ROWS_F64 if _is_f64(t) else _FOLD_ROWS
     acc = t[..., :NLIMBS]
-    for j in range(n_hi):
-        acc = acc + t[..., NLIMBS + j : NLIMBS + j + 1] * rows[j]
+    if _is_f64(t):
+        acc = acc + jax.lax.dot_general(
+            t[..., NLIMBS:],
+            _FOLD_ROWS_F64[:n_hi],
+            (((t.ndim - 1,), (0,)), ((), ())),
+        )
+    else:
+        rows = _FOLD_ROWS
+        for j in range(n_hi):
+            acc = acc + t[..., NLIMBS + j : NLIMBS + j + 1] * rows[j]
     lo_b, hi_b = s.limbs[:NLIMBS], s.limbs[NLIMBS:]
     limbs = [
         b + sum(hb * int(_FOLD_NP[j, i]) for j, hb in enumerate(hi_b))
@@ -559,13 +603,27 @@ def _drop_zero_tops(t, s: _RState):
     return t, s
 
 
-def reduce_limbs(t, limb_bounds, value_bound: int):
-    """Reduce [..., N] (N >= 25) to plans.PUB_BOUND: value < 13p, 17-bit limbs,
-    top limb <= 2. Statically scheduled congruence folds + elementwise carry
-    rounds — fully while-free; bounds proved at trace time. Dtype-generic:
-    an f64 input runs the whole walk in f64 (exactness cap 2^53 instead of
-    2^64 — a slightly longer schedule of cheaper, fusion-friendly FMA steps)
-    and is cast to u64 at the end."""
+def reduce_limbs(
+    t,
+    limb_bounds,
+    value_bound: int,
+    value_limit: int = PUB_VALUE_LIMIT,
+    limb_target: int = PUB_LIMB_TARGET,
+):
+    """Reduce [..., N] (N >= 25) to value <= value_limit, limbs <= limb_target
+    (defaults: plans.PUB_BOUND — value < 13p, 17-bit limbs, top limb <= 2).
+    Statically scheduled congruence folds + elementwise carry rounds — fully
+    while-free; bounds proved at trace time. Dtype-generic: an f64 input runs
+    the whole walk in f64 (exactness cap 2^53 instead of 2^64 — a slightly
+    longer schedule of cheaper, fusion-friendly FMA steps) and is cast to u64
+    at the end.
+
+    A LAZIER target (plans.CHAIN_BOUND: value < 64p, 20-bit limbs) trims the
+    tail of the walk — fewer 2^384 folds and carry rounds. Fixed-exponent /
+    fixed-scalar chains (chain_plans) run their interior ops at that target:
+    the output re-enters the next convolution directly (limbs < 2^22, value
+    < 1200p budget) and only the chain's final result pays the full
+    normalization."""
     cap = _cap_of(t)
     s = _RState(list(limb_bounds), value_bound)
     # phase 1: fold down to 25 limbs
@@ -585,7 +643,7 @@ def reduce_limbs(t, limb_bounds, value_bound: int):
         raise AssertionError("reduce_limbs: phase 1 did not converge")
     # phase 2: one approximate walk, wide enough that no carry is dropped
     n_out = max(NLIMBS + 1, -(-s.value.bit_length() // LIMB_BITS) + 1)
-    t, s = _propagate_approx(t, s, n_out)
+    t, s = _propagate_approx(t, s, n_out, limb_target)
     # phase 3: drain high limbs and the 2^384 excess — all elementwise
     for _ in range(64):
         t, s = _drop_zero_tops(t, s)
@@ -597,7 +655,7 @@ def reduce_limbs(t, limb_bounds, value_bound: int):
                 t, s = _fold_high(t, s)
             else:
                 t, s = _carry_round(t, s)
-        elif s.value > PUB_VALUE_LIMIT:
+        elif s.value > value_limit:
             # fold only when it provably shrinks the value (the excess may sit
             # in low limbs after a previous fold — surface it with a carry)
             lo_val = sum(
@@ -613,14 +671,20 @@ def reduce_limbs(t, limb_bounds, value_bound: int):
             break
     else:  # pragma: no cover - static schedule
         raise AssertionError("reduce_limbs: phase 3 did not converge")
-    # phase 4: final approximate walk to 17-bit limbs (top <= 2 since
-    # value < 13p and limbs are non-negative: limb24 <= value >> 384)
-    t, s = _propagate_approx(t, s, NLIMBS)
-    assert s.value <= PUB_VALUE_LIMIT
-    assert max(s.limbs) <= PUB_LIMB_TARGET
-    assert min(s.limbs[24], s.value >> (LIMB_BITS * 24)) <= 2
+    # phase 4: final approximate walk to limb_target-bit limbs (PUB target:
+    # top <= 2 since value < 13p and limbs are non-negative:
+    # limb24 <= value >> 384)
+    t, s = _propagate_approx(t, s, NLIMBS, limb_target)
+    assert s.value <= value_limit
+    assert max(s.limbs) <= limb_target
+    if value_limit == PUB_VALUE_LIMIT:
+        assert min(s.limbs[24], s.value >> (LIMB_BITS * 24)) <= 2
     if _is_f64(t):
-        t = t.astype(jnp.uint64)  # exact: limbs <= 2^17
+        # materialization fence + exact cast (limbs <= limb_target < 2^53):
+        # without the barrier XLA CPU duplicates the whole elementwise walk
+        # into every consumer of the result (the conv chain's known
+        # recompute pathology — measured 6x on a composed point_add)
+        t = jax.lax.optimization_barrier(t).astype(jnp.uint64)
     return t
 
 
@@ -641,11 +705,12 @@ def mont_mul(a, b):
     _IN_LIMB (2^22); output satisfies plans.PUB_BOUND (< 13p, 16-bit limbs,
     top <= 2).
 
-    The conv runs in f64 (CPU) / f32 digits (TPU) and is cast back to u64 for
-    the fold walk — the cast doubles as a fusion barrier; an all-f64 fused
-    conv+reduce graph made XLA CPU recompute the conv chain per consumer
-    (measured 6x slower)."""
-    t = _conv_product(a, b)
+    The conv runs in f64 (CPU) / f32 digits (TPU). On the f64 backend the
+    fold walk stays in f64 as well (u64 multiplies scalarize on x86 — see
+    _conv_product_keep); the conv chain's optimization_barrier fences the
+    graph so XLA does not recompute it per consumer (the historical all-f64
+    pathology)."""
+    t = _conv_product_keep(a, b)
     return reduce_limbs(t, conv_limb_bounds(_IN_LIMB), _IN_VALUE * _IN_VALUE)
 
 
@@ -653,9 +718,46 @@ def mont_sqr(a):
     return mont_mul(a, a)
 
 
+# Lazy chain target (see reduce_limbs): interior values of fixed-exponent /
+# fixed-scalar chains. 20-bit limbs and value < 64p re-enter the convolution
+# budget directly (f64: 25 * 2^40 < 2^53; digits: per-digit < 2^24), so chain
+# steps skip the tail of the reduction walk. Must stay in sync with
+# plans.CHAIN_BOUND.
+CHAIN_LIMB_TARGET = (1 << 20) - 1
+CHAIN_VALUE_LIMIT = 64 * P
+
+
+def mont_mul_lazy(a, b):
+    """Chain-interior product: operands at (or below) the lazy chain bound
+    (limbs <= CHAIN_LIMB_TARGET, value <= CHAIN_VALUE_LIMIT); output at the
+    same bound — a fixed point, so chains of any length stay in budget.
+    Shorter reduction walk than mont_mul (bound-precise conv inputs AND a
+    lazier target)."""
+    t = _conv_product_keep(a, b)
+    return reduce_limbs(
+        t,
+        conv_limb_bounds(CHAIN_LIMB_TARGET),
+        CHAIN_VALUE_LIMIT * CHAIN_VALUE_LIMIT,
+        CHAIN_VALUE_LIMIT,
+        CHAIN_LIMB_TARGET,
+    )
+
+
+def mont_sqr_lazy(a):
+    return mont_mul_lazy(a, a)
+
+
 def canonical(a):
     """Fully reduce to the canonical residue < p (comparisons, parity,
-    serialization). Accepts anything within the lazy budget."""
+    serialization). Accepts anything within the lazy budget. On the f64
+    backend (at winning row counts) the fold walk runs in f64 (see
+    _conv_product_keep)."""
+    if (
+        conv_backend() == "f64"
+        and not _is_f64(a)
+        and _static_rows(a) >= F64_WALK_MIN_ROWS
+    ):
+        a = a.astype(jnp.float64)
     t = reduce_limbs(a, [_IN_LIMB] * a.shape[-1], _IN_VALUE)
     # reduce_limbs leaves 17-bit limbs (PUB_LIMB_TARGET); the 2^381 folds
     # below mask limbs to 16 bits (_MASK_LOW381), so an EXACT propagation
@@ -683,42 +785,26 @@ def from_mont(a):
 # Fixed-exponent powers (spec constants: inversion, sqrt)
 # --------------------------------------------------------------------------------------
 
-def _pow_digits(e: int, window: int) -> list[int]:
-    """Base-2^window digits of e, MSB first."""
-    ndig = max(-(-max(e.bit_length(), 1) // window), 1)
-    return [(e >> (window * (ndig - 1 - i))) & ((1 << window) - 1)
-            for i in range(ndig)]
-
-
-def windowed_pow(a, e: int, sqr_fn, mul_fn, one_arr, window: int = 4):
-    """a^e for a fixed host-side exponent: 2^window-entry table + one lax.scan
-    over the base-2^window digits (window squarings + ONE table multiply per
-    step). Quarter the iterations — and less total work — than the bit ladder;
-    per-iteration while-loop overhead dominated the old 380-step scans."""
-    # table[i] = a^i; table[0] = one (digit 0 needs no masking)
-    entries = [jnp.broadcast_to(one_arr, a.shape) + a * jnp.uint64(0), a]
-    for _ in range(2, 1 << window):
-        entries.append(mul_fn(entries[-1], a))
-    table = jnp.stack(entries, axis=0)
-    digits = jnp.asarray(_pow_digits(e, window), dtype=jnp.int32)
-
-    def step(res, digit):
-        for _ in range(window):
-            res = sqr_fn(res)
-        return mul_fn(res, jax.lax.dynamic_index_in_dim(
-            table, digit, axis=0, keepdims=False
-        )), None
-
-    res0 = jax.lax.dynamic_index_in_dim(
-        table, digits[0], axis=0, keepdims=False
-    )
-    res, _ = jax.lax.scan(step, res0, digits[1:])
-    return res
-
-
 def pow_fixed_scan(a, e: int):
-    """a^e for a fixed host-side exponent (windowed; see windowed_pow)."""
-    return windowed_pow(a, e, mont_sqr, mont_mul, ONE_M)
+    """a^e for a fixed host-side exponent, compiled by the fixed-scalar plan
+    machinery (chain_plans): windowed schedule with a log-depth table build
+    and LAZY interior bounds — only the final result pays the full
+    normalization walk. Accepts anything within the lazy budget: the base is
+    first brought to the chain bound the interior ops' static schedules
+    assume (limbs <= CHAIN_LIMB_TARGET, value <= CHAIN_VALUE_LIMIT)."""
+    from . import chain_plans
+
+    a = reduce_limbs(
+        a, [_IN_LIMB] * a.shape[-1], _IN_VALUE,
+        CHAIN_VALUE_LIMIT, CHAIN_LIMB_TARGET,
+    )
+    sched = chain_plans.compile_chains((int(e),), signed=False)
+    out = chain_plans.run_field_chains(
+        sched, a[None, ..., None, :], mont_sqr_lazy, mont_mul_lazy, ONE_M
+    )[0, ..., 0, :]
+    # restore the public bound (callers feed comparisons and PUB-contract
+    # plan inputs)
+    return reduce_limbs(out, [CHAIN_LIMB_TARGET] * NLIMBS, CHAIN_VALUE_LIMIT)
 
 
 def inv(a):

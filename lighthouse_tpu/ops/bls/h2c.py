@@ -14,13 +14,11 @@ Parity: blst's hash-or-encode path used by the reference's sign/verify
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax.numpy as jnp
 
 from . import curve, fq, g2, plans, tower
 from ..bls_oracle import hash_to_curve as _oh
-from ..bls_oracle.fields import P, BLS_X, Fq2
+from ..bls_oracle.fields import BLS_X, Fq2
 
 # -- host: hash_to_field --------------------------------------------------------------
 
@@ -45,8 +43,6 @@ def _c2(v: Fq2):
 _A = _c2(_oh.ISO_A)
 _B = _c2(_oh.ISO_B)
 _Z = _c2(_oh.SSWU_Z)
-_C1 = _c2(-_oh.ISO_B * _oh.ISO_A.inv())          # -B/A
-_C2 = _c2(_oh.ISO_B * (_oh.SSWU_Z * _oh.ISO_A).inv())  # B/(Z*A)
 
 _KX_NUM = [_c2(k) for k in _oh._K["x_num"]]
 _KX_DEN = [_c2(k) for k in _oh._K["x_den"]]
@@ -61,58 +57,90 @@ def _bc(c, like):
 # -- device: simplified SWU on E' ----------------------------------------------------
 
 
-def map_to_curve_sswu(u):
-    """u [..., 2, 25] -> affine (x, y) on the isogenous curve E'. Branchless
-    (RFC 9380 6.6.2 with inv0/select semantics)."""
+def map_to_curve_sswu_fraction(u):
+    """u [..., 2, 25] -> (xn, xd, y): x = xn/xd on E' as a FRACTION, y exact.
+
+    The RFC 9380 appendix F.2 straight-line form of 6.6.2: the x-coordinate
+    is never inverted (the 3-isogeny consumes the fraction and the final
+    projective point absorbs the denominator), and ONE sqrt_ratio chain
+    serves both candidates — gx2 = Z^3 u^6 gx1, so the non-square branch's
+    root is tv1·u·y1 with no second exponentiation. Replaces the 6.6.2
+    direct form's three sequential Fermat chains (inv0, a^((p-3)/4),
+    (α+1)^((p-1)/2)) with a single joint chain (tower.fq2_sqrt_ratio).
+
+    ``u`` must be canonical (hash_to_field outputs are) — sgn0(u) reads limb
+    parity without a reduction walk."""
+    A_M = _bc(_A, u)
+    B_M = _bc(_B, u)
     u2 = tower.fq2_sqr(u)
-    zu2 = tower.fq2_mul(_bc(_Z, u), u2)
-    tv = plans.carry_norm(tower.fq2_sqr(zu2) + zu2)
-    tv_zero = tower.t_is_zero(tv)
-    tv1 = tower.fq2_inv(tv)  # inv0
+    tv1 = tower.fq2_mul(_bc(_Z, u), u2)                     # Z u^2
+    tv2 = plans.carry_norm(tower.fq2_sqr(tv1) + tv1)        # Z^2u^4 + Zu^2
+    tv2_nz = ~tower.t_is_zero(tv2)
     one = tower.one(2, u.shape[:-2])
-    x1 = tower.fq2_mul(_bc(_C1, u), plans.carry_norm(one + tv1))
-    x1 = tower.t_select(tv_zero, _bc(_C2, u), x1)
+    tv3 = tower.fq2_mul(B_M, plans.carry_norm(tv2 + one))   # x1 numerator
+    neg_tv2 = plans.carry_norm(tower.fq2_neg(tv2))
+    tv4 = tower.fq2_mul(
+        A_M, tower.t_select(tv2_nz, neg_tv2, _bc(_Z, u))
+    )                                                       # x1 denominator
+    tv3s, tv4s = tower.fq2_mul_many([(tv3, tv3), (tv4, tv4)])
+    tv3c, tv4c, t34 = tower.fq2_mul_many(
+        [(tv3s, tv3), (tv4s, tv4), (tv4s, tv3)]
+    )
+    a34, b4c = tower.fq2_mul_many([(t34, A_M), (tv4c, B_M)])
+    gx1_num = plans.carry_norm(tv3c + a34 + b4c)  # tv3^3 + A tv3 tv4^2 + B tv4^3
+    is_sq, y1 = tower.fq2_sqrt_ratio(gx1_num, tv4c)
+    # candidate 2 (gx1 non-square): x2 = tv1 x1, y2 = tv1 u y1
+    t1u = tower.fq2_mul(tv1, u)
+    y2, x2n = tower.fq2_mul_many([(t1u, y1), (tv1, tv3)])
+    xn = tower.t_select(is_sq, tv3, x2n)
+    y = tower.t_select(is_sq, y1, y2)
+    # u arrives canonical from hash_to_field (host from_ints) — its sgn0
+    # needs no reduction walk; y is a fresh multiply output and does. The
+    # negation works on the PUB-bounded y directly (borrow-inflated
+    # constant): no canonicalization needed before it.
+    flip = tower.fq2_sgn0_canon(u) != tower.fq2_sgn0(y)
+    y = plans.carry_norm(tower.t_select(flip, tower.fq2_neg(y), y))
+    return xn, tv4, y
 
-    def g_of(x):
-        return plans.carry_norm(
-            tower.fq2_mul(plans.carry_norm(tower.fq2_sqr(x) + _bc(_A, u)), x)
-            + _bc(_B, u)
-        )
 
-    gx1 = g_of(x1)
-    x2 = tower.fq2_mul(zu2, x1)
-    gx2 = g_of(x2)
-    # one stacked sqrt for both candidates (halves the compiled chain)
-    y12, ok12 = tower.fq2_sqrt(jnp.stack([gx1, gx2], axis=0))
-    is_sq = ok12[0]
-    x = tower.t_select(is_sq, x1, x2)
-    y = tower.t_select(is_sq, y12[0], y12[1])
-    flip = tower.fq2_sgn0(u) != tower.fq2_sgn0(y)
-    y = plans.carry_norm(tower.t_select(flip, tower.fq2_neg(tower.t_canon(y)), y))
-    return x, y
+def map_to_curve_sswu(u):
+    """u [..., 2, 25] -> affine (x, y) on the isogenous curve E' (RFC 9380
+    6.6.2 semantics). Affine convenience wrapper over the fraction form —
+    the production path (map_to_g2) never divides."""
+    xn, xd, y = map_to_curve_sswu_fraction(u)
+    return tower.fq2_mul(xn, tower.fq2_inv(xd)), y
 
 
 # -- device: 3-isogeny map ------------------------------------------------------------
 
 
-def iso_map(x, y):
-    """Affine E' point -> projective E2 point [..., 6, 25].
+def iso_map_fraction(xn, xd, y):
+    """E' point with x = xn/xd (fraction) and exact y -> projective E2 point
+    [..., 6, 25].
 
-    All four Horner chains share powers of x; each level's four multiplies run
-    as one stacked kernel (fq2_mul_many). Projective output avoids the two
-    inversions: (X:Y:Z) = (x_num * y_den, y * y_num * x_den, x_den * y_den).
-    """
+    Each Horner level homogenizes with the matching power of xd:
+    P(xn/xd)·xd^3 = ((k3·xn + k2·xd)·xn + k1·xd^2)·xn + k0·xd^3 — the xd^3
+    factor is shared by all four polynomials and cancels in the projective
+    ratios, so the output formula is unchanged:
+    (X:Y:Z) = (x_num' y_den', y y_num' x_den', x_den' y_den'). All four
+    acc·xn products and all four k·xd^j products of a level run as ONE
+    stacked kernel (fq2_mul_many)."""
     tables = [_KX_NUM, _KX_DEN, _KY_NUM, _KY_DEN]
     max_len = max(len(t) for t in tables)
-    # pad shorter polynomials (x_den is degree 2) with a leading zero
-    # coefficient so all four Horner chains share the same depth
     zero2 = tower.zero(2)
     tables = [t + [zero2] * (max_len - len(t)) for t in tables]
-    accs = [_bc(t[-1], x) for t in tables]
+    xd2 = tower.fq2_sqr(xd)
+    xd3 = tower.fq2_mul(xd2, xd)
+    xd_pows = [None, xd, xd2, xd3]  # xd^(depth-level)
+    accs = [_bc(t[-1], xn) for t in tables]
     for lvl in range(max_len - 2, -1, -1):
-        prods = tower.fq2_mul_many([(a, x) for a in accs])
+        pairs = [(a, xn) for a in accs] + [
+            (_bc(t[lvl], xn), xd_pows[max_len - 1 - lvl]) for t in tables
+        ]
+        prods = tower.fq2_mul_many(pairs)
         accs = [
-            plans.carry_norm(p + _bc(t[lvl], x)) for p, t in zip(prods, tables)
+            plans.carry_norm(p + kx)
+            for p, kx in zip(prods[:4], prods[4:])
         ]
     x_num, x_den, y_num, y_den = accs
     xz, yz, zz = tower.fq2_mul_many(
@@ -121,21 +149,28 @@ def iso_map(x, y):
     return jnp.concatenate([xz, yz, zz], axis=-2)
 
 
+def iso_map(x, y):
+    """Affine E' point -> projective E2 point (degenerate-fraction wrapper)."""
+    one = tower.one(2, x.shape[:-2])
+    return iso_map_fraction(x, one, y)
+
+
 # -- device: cofactor clearing (Budroni–Pintore) -------------------------------------
-
-
-def _mul_by_abs_x(p):
-    return curve.scale_fixed(2, p, -BLS_X)  # |x| (BLS_X negative)
 
 
 def clear_cofactor(p):
     """[x^2-x-1]P + [x-1]psi(P) + psi^2(2P) with x < 0:
     = [x]([x]P) - [x]P - P + [x]psi(P) - psi(P) + psi^2(2P)
     where [x]Q = -[|x|]Q. psi commutes with scalar multiplication
-    ([x]psi(P) = psi([x]P)), so only TWO |x|-chains are needed (they are
-    sequentially dependent: x^2 needs xP)."""
-    xP = curve.point_neg(2, _mul_by_abs_x(p))          # [x]P
-    xxP = curve.point_neg(2, _mul_by_abs_x(xP))        # [x^2]P
+    ([x]psi(P) = psi([x]P)), so only TWO |x|-chains are needed — they are
+    sequentially dependent (x^2 needs xP), which is exactly why this BP form
+    beats the joint-axis [x^2-x-1 ; x-1] alternative here: |x| is weight-6
+    sparse, so two wNAF chains cost 124 dbl + ~10 add total, the same
+    doubling depth as one dense 127-bit chain but a third of its adds and at
+    half the kernel width. Each chain runs as a compiled plan
+    (chain_plans.scale_fixed_chain via curve.scale_fixed)."""
+    xP = curve.scale_fixed(2, p, BLS_X)                # [x]P (sign in plan)
+    xxP = curve.scale_fixed(2, xP, BLS_X)              # [x^2]P
     psiP = g2.psi(p)
     xpsiP = g2.psi(xP)                                 # [x]psi(P) = psi([x]P)
     psi2_2P = g2.psi(g2.psi(curve.point_dbl(2, p)))
@@ -152,9 +187,10 @@ def clear_cofactor(p):
 def map_to_g2(u0, u1):
     """Device map: two field elements per message -> projective G2 point.
     u0/u1 are stacked into one doubled leading batch so SSWU + the isogeny
-    compile (and dispatch) ONCE instead of twice."""
+    compile (and dispatch) ONCE instead of twice; x-coordinates stay in
+    fraction form end-to-end (the projective output absorbs denominators)."""
     u = jnp.stack([u0, u1], axis=0)
-    q = iso_map(*map_to_curve_sswu(u))
+    q = iso_map_fraction(*map_to_curve_sswu_fraction(u))
     return clear_cofactor(curve.point_add(2, q[0], q[1]))
 
 

@@ -272,6 +272,13 @@ def sub_bound(minuend: "_Bound", subtrahend: "_Bound") -> "_Bound":
 
 PUB_BOUND = _Bound(PUB_VALUE_P, PUB_LIMB, PUB_TOP_LIMB)
 CANON_BOUND = _Bound(1, (1 << 16) - 1, 0)  # canonical values are exact 16-bit
+# Lazy chain-interior bound (fq.CHAIN_LIMB_TARGET / fq.CHAIN_VALUE_LIMIT):
+# 20-bit limbs, value < 64p, top limb <= 64p >> 384 = 7. A fixed point of
+# chain steps — outputs at this bound feed the next step's lincombs within
+# the lazy budget, skipping the tail of the reduction walk (see
+# fq.reduce_limbs). PUB_BOUND inputs are below it, so chains start from
+# public values without renormalization.
+CHAIN_BOUND = _Bound(64, (1 << 20) - 1, 7)
 
 
 def _lincomb_bounds(rows: list[LC], bound_for, name: str):
@@ -327,19 +334,25 @@ def _apply_matrices(m_pos, m_neg, consts, x):
     """rows @ x as two constant-matrix dot_generals plus the borrow constants:
     out[..., r, :] = (M_pos @ x) + (C_r - M_neg @ x). The dot form emits ~5 HLO
     ops per lincomb where the term-by-term form emitted hundreds (slice +
-    scale + add per coefficient) — program size was the r3 compile bottleneck."""
+    scale + add per coefficient) — program size was the r3 compile bottleneck.
+
+    Dtype follows x: an f64 operand gets f64 matrices/constants (exact — every
+    bound is asserted < 2^53 by the callers), keeping the pipeline off u64
+    multiplies, which have no SIMD path on CPU."""
+    f64 = x.dtype == jnp.float64
+    dt = jnp.float64 if f64 else jnp.uint64
     dn = (((1,), (x.ndim - 2,)), ((), ()))
     pos = jax.lax.dot_general(
-        jnp.asarray(m_pos), x, dn, preferred_element_type=jnp.uint64
+        jnp.asarray(m_pos, dtype=dt), x, dn, preferred_element_type=dt
     )
     pos = jnp.moveaxis(pos, 0, -2)
     if not m_neg.any():
         return pos
     neg = jax.lax.dot_general(
-        jnp.asarray(m_neg), x, dn, preferred_element_type=jnp.uint64
+        jnp.asarray(m_neg, dtype=dt), x, dn, preferred_element_type=dt
     )
     neg = jnp.moveaxis(neg, 0, -2)
-    return pos + (jnp.asarray(consts) - neg)
+    return pos + (jnp.asarray(consts, dtype=dt) - neg)
 
 
 def lincomb(rows: list[LC], x, in_bound: _Bound, name: str = "", bound_for=None) -> tuple:
@@ -424,36 +437,67 @@ def _subc_wide(n_limbs: int, cover: int) -> np.ndarray:
     return _SUBC_WIDE_CACHE[key]
 
 
-def execute(plan: Plan, a, b, in_bound_a=PUB_BOUND, in_bound_b=PUB_BOUND, name=""):
+def execute(
+    plan: Plan, a, b, in_bound_a=PUB_BOUND, in_bound_b=PUB_BOUND, name="",
+    out_bound: "_Bound | None" = None,
+):
     """Run a plan: returns [..., n_out, 25] public-bounded output.
 
     The output linear maps commute with reduction, so they run on the
     *unreduced* convolution accumulators: conv -> out-lincomb (wide limbs) ->
     ONE congruence-fold reduction per OUTPUT row. An Fq12 multiply reduces 12
     rows instead of its 54 Karatsuba lanes, and the fold reduction already
-    lands on plans.PUB_BOUND — no trailing carry_norm."""
+    lands on plans.PUB_BOUND — no trailing carry_norm.
+
+    ``out_bound=CHAIN_BOUND`` requests the lazier chain-interior target
+    instead (shorter reduction walk; see fq.reduce_limbs) — used by
+    chain_plans for the interiors of fixed-exponent scans.
+
+    On the f64 conv backend (CPU), at row counts where the f64 walk wins
+    (fq.F64_WALK_MIN_ROWS), the ENTIRE pipeline — input lincombs,
+    convolution, out-lincomb, reduction walk — runs in f64 and only the
+    final reduced limbs are cast back to u64: u64 multiplies have no x86
+    SIMD path and dominated the execute cost. Exactness: every intermediate
+    bound is asserted below the 2^53 f64 integer cap."""
+    lane_rows = fq._static_rows(a[..., 0, :]) * len(plan.a_rows)
+    if fq.conv_backend() == "f64" and lane_rows >= fq.F64_WALK_MIN_ROWS:
+        a = a.astype(jnp.float64)
+        b = b.astype(jnp.float64)
     A, ba = lincomb(plan.a_rows, a, in_bound_a, name + ".A")
     if plan.consts:
         cpool = jnp.asarray(
             np.stack([fq.int_to_limbs(c) for c in plan.consts])
         )
         cpool = jnp.broadcast_to(cpool, b.shape[:-2] + cpool.shape)
-        b = jnp.concatenate([b, cpool], axis=-2)
+        b = jnp.concatenate([b, cpool.astype(b.dtype)], axis=-2)
     B, bb = lincomb(plan.b_rows, b, in_bound_b, name + ".B")
-    T = fq._conv_product(A, B)  # [..., L, 50] unreduced accumulators
-    # one elementwise carry round caps limbs (~2^33) so out-row accumulation
-    # and subtraction covers stay inside uint64
+    T = fq._conv_product_keep(A, B)  # [..., L, 50] unreduced accumulators
     conv_limb = max(fq.conv_limb_bounds(ba.limb, bb.limb))
+    cap = fq._cap_of(T)
     assert conv_limb < 1 << 63, f"{name}: conv accumulator overflow"
-    lane_limb = (1 << 16) + (conv_limb >> 16)
-    T = fq._carry_round_array(T)  # [..., L, 51]
+    # a carry round caps limbs (~2^33) so out-row accumulation and
+    # subtraction covers stay inside the dtype cap (f64: 2^53) — SKIPPED
+    # when the raw conv bounds already fit (common for lazy chain interiors,
+    # whose tighter inputs leave headroom): a row's accumulator is at most
+    # sum(|coeff|) * lane_limb for the positive part plus a borrow constant
+    # that itself covers the negative part, so 2x the full coefficient sum
+    # dominates both
+    coeff_sum = max(
+        (sum(abs(c) for c in lc.d.values()) for lc in plan.out_rows),
+        default=1,
+    )
+    if 2 * coeff_sum * conv_limb + (1 << 20) < cap:
+        lane_limb = conv_limb
+    else:
+        T = fq._carry_round_array(T)  # [..., L, 51]
+        lane_limb = (1 << 16) + (conv_limb >> 16)
     n_wide = T.shape[-1]
     L = len(plan.a_rows)
     has_passthrough = any(i < 0 for lc in plan.out_rows for i in lc.d)
     if has_passthrough:
         # pass-through rows reference `a`: zero-pad it into the wide space
         pad = [(0, 0)] * (a.ndim - 1) + [(0, n_wide - a.shape[-1])]
-        T = jnp.concatenate([T, jnp.pad(a, pad)], axis=-2)
+        T = jnp.concatenate([T, jnp.pad(a, pad).astype(T.dtype)], axis=-2)
         out_rows = [
             LC({(i if i >= 0 else L - 1 - i): c for i, c in lc.d.items()})
             for lc in plan.out_rows
@@ -477,12 +521,24 @@ def execute(plan: Plan, a, b, in_bound_a=PUB_BOUND, in_bound_b=PUB_BOUND, name="
             subc = _subc_wide(n_wide, n_limb)
             consts[r] = subc
             limb += int(subc.max())
-        assert limb < 1 << 63, f"{name}: wide accumulator bound 2^{limb.bit_length()}"
+        assert limb < cap, f"{name}: wide accumulator bound 2^{limb.bit_length()}"
         worst_limb = max(worst_limb, limb)
     m_pos, m_neg = _lincomb_matrices(out_rows, T.shape[-2])
     out = _apply_matrices(m_pos, m_neg, consts, T)
     value_bound = sum(worst_limb << (16 * i) for i in range(n_wide))
-    return fq.reduce_limbs(out, [worst_limb] * n_wide, value_bound)
+    if out_bound is None:
+        return fq.reduce_limbs(out, [worst_limb] * n_wide, value_bound)
+    # the declared top-limb bound must dominate what the walk guarantees
+    assert out_bound.top >= min(
+        out_bound.limb, (out_bound.value_p * P) >> (16 * 24)
+    ), "out_bound.top unsound for its value/limb bounds"
+    return fq.reduce_limbs(
+        out,
+        [worst_limb] * n_wide,
+        value_bound,
+        out_bound.value_p * P,
+        out_bound.limb,
+    )
 
 
 # --------------------------------------------------------------------------------------

@@ -179,13 +179,15 @@ def fq2_inv(a):
     return r
 
 
-def fq2_pow_fixed(a, e: int):
-    """a^e for a fixed exponent (windowed table scan; see fq.windowed_pow)."""
-    return fq.windowed_pow(a, e, fq2_sqr, fq2_mul, one(2))
-
-
 def fq2_sgn0(a):
     c = fq.from_mont(a)  # one canonicalization (from_mont fully reduces)
+    return fq2_sgn0_canon(c)
+
+
+def fq2_sgn0_canon(c):
+    """RFC 9380 sgn0 of an ALREADY-CANONICAL element (skips the reduction
+    walk — e.g. hash_to_field outputs, which arrive canonical from the
+    host)."""
     c0, c1 = c[..., 0, :], c[..., 1, :]
     s0 = c0[..., 0] & jnp.uint64(1)
     z0 = fq.is_zero(c0)
@@ -193,22 +195,141 @@ def fq2_sgn0(a):
     return s0 | (z0.astype(jnp.uint64) & s1)
 
 
+def fq2_sqr_lazy(a, in_bound=None):
+    """Chain-interior square: lazy in/out bounds (plans.CHAIN_BOUND)."""
+    b = in_bound or plans.CHAIN_BOUND
+    return plans.execute(
+        plans.SQR2, a, a, b, b, "fq2_sqr_c", out_bound=plans.CHAIN_BOUND
+    )
+
+
+def fq2_mul_lazy(a, b, in_bound=None):
+    """Chain-interior product: lazy in/out bounds (plans.CHAIN_BOUND)."""
+    bd = in_bound or plans.CHAIN_BOUND
+    return plans.execute(
+        plans.MUL2, a, b, bd, bd, "fq2_mul_c", out_bound=plans.CHAIN_BOUND
+    )
+
+
+# --------------------------------------------------------------------------------------
+# Fq2 square roots: one fixed-exponent chain (q = p^2, q ≡ 9 mod 16)
+# --------------------------------------------------------------------------------------
+#
+# q - 1 = 8 m with m odd, so Tonelli–Shanks needs only the 8th roots of unity:
+# ONE chain t = w^((q-9)/16) (= w^((m-1)/2)) yields z = t^2 w = w^m ∈ μ8, and
+# the candidate root is r = t·w (r^2 = z·w) corrected by a PRECOMPUTED
+# constant c with c^2 = 1/z — no second exponentiation, unlike the classic
+# two-chain (a^((p-3)/4), (α+1)^((p-1)/2)) method this replaces. The chain
+# itself runs as a 2-lane joint plan (chain_plans): w^e0 · conj(w)^e1 with
+# (q-9)/16 = e0 + e1·p — Frobenius in Fq2 is conjugation, so both ~381-bit
+# lanes share every squaring dispatch. Non-residues (z ∈ μ8 \ μ4) fold the Z
+# correction of RFC 9380's sqrt_ratio into the same constant table.
+
+_Q = _of.P * _of.P
+assert _Q % 16 == 9
+_M8 = (_Q - 1) // 8                      # odd
+_SQRT_E = (_Q - 9) // 16                 # (m-1)/2
+_SQRT_E1, _SQRT_E0 = divmod(_SQRT_E, _of.P)
+
+
+def _fq2_pow_host(a: "_of.Fq2", e: int) -> "_of.Fq2":
+    r = _of.Fq2(1, 0)
+    while e:
+        if e & 1:
+            r = r * a
+        a = a.square()
+        e >>= 1
+    return r
+
+
+def _sqrt_constants():
+    from ..bls_oracle.fields import fq_sqrt
+    from ..bls_oracle.hash_to_curve import SSWU_Z
+
+    # zeta = b(1 - u) with b^2 = -1/2 has zeta^2 = u: an order-8 root of unity
+    b = fq_sqrt((-pow(2, _of.P - 2, _of.P)) % _of.P)
+    assert b is not None
+    zeta = _of.Fq2(b, _of.P - b)
+    assert _fq2_pow_host(zeta, 8) == _of.Fq2(1, 0)
+    assert _fq2_pow_host(zeta, 4) != _of.Fq2(1, 0)
+    roots8 = [_fq2_pow_host(zeta, i) for i in range(8)]
+    # Z^m locates the sswu nonresidue Z inside μ8 (odd index: Z is a non-QR)
+    zm = _fq2_pow_host(SSWU_Z, _M8)
+    jz = roots8.index(zm)
+    assert jz % 2 == 1
+    z_half = _fq2_pow_host(SSWU_Z, (_M8 + 1) // 2)
+    cf = []
+    for j in range(8):
+        if j % 2 == 0:
+            # z = zeta^j square: c^2 = z^-1
+            cf.append(roots8[(8 - j) // 2 % 8])
+        else:
+            # z odd: correct Z·w instead — (Zw)^m = zeta^(j+jz) (even)
+            j2 = (j + jz) % 8
+            cf.append(z_half * roots8[(8 - j2) // 2 % 8])
+    roots_dev = jnp.stack([fq2_from_oracle(r) for r in roots8])
+    cf_dev = jnp.stack([fq2_from_oracle(c) for c in cf])
+    return roots_dev, cf_dev
+
+
+_ROOTS8, _SQRT_CF = _sqrt_constants()
+
+
+def _sqrt_chain(w):
+    """w^((q-9)/16) as the 2-lane joint Frobenius chain."""
+    from . import chain_plans
+
+    sched = chain_plans.compile_chains((_SQRT_E0, _SQRT_E1), signed=False)
+    bases = jnp.stack([w, plans.carry_norm(fq2_conj(w))])
+    out = chain_plans.run_field_chains(
+        sched, bases, fq2_sqr_lazy, fq2_mul_lazy, one(2)
+    )
+    return plans.execute(
+        plans.MUL2, out[0], out[1], plans.CHAIN_BOUND, plans.CHAIN_BOUND,
+        "sqrt_t",
+    )
+
+
+def _sqrt_core(w):
+    """(is_qr, t, cf) for w: t = w^((q-9)/16); cf the μ8 correction constant.
+    The caller's root is t·w·cf (times Z-folded factors for non-residues,
+    already folded into cf). w == 0 -> is_qr True, root 0."""
+    t = _sqrt_chain(w)
+    z = fq2_mul(fq2_sqr(t), w)                    # w^m ∈ μ8 (or 0)
+    zc = t_canon(z)
+    matches = jnp.all(
+        zc == _ROOTS8.reshape((8,) + (1,) * (zc.ndim - 2) + zc.shape[-2:]),
+        axis=(-2, -1),
+    )                                              # [8, *batch]
+    odd = matches[1::2].any(axis=0)
+    is_qr = ~odd
+    cf = jnp.zeros_like(zc)
+    for j in range(8):
+        cf = cf + jnp.where(
+            matches[j][..., None, None], _SQRT_CF[j], jnp.zeros_like(cf)
+        )
+    return is_qr, t, cf
+
+
 def fq2_sqrt(a):
-    """Square root in Fq2 (p = 3 mod 4). Returns (root, is_square)."""
-    a1 = fq2_pow_fixed(a, (_of.P - 3) // 4)
-    x0 = fq2_mul(a1, a)
-    alpha = fq2_mul(a1, x0)
-    minus_one = from_ints([_of.P - 1, 0])
-    is_minus_one = t_eq(alpha, jnp.broadcast_to(minus_one, alpha.shape))
-    x0c = t_canon(x0)
-    cand_a = jnp.stack(
-        [fq.neg(x0c[..., 1, :]), x0c[..., 0, :]], axis=-2
-    )  # u * x0
-    b = fq2_pow_fixed(fq2_add(alpha, one(2, alpha.shape[:-2])), (_of.P - 1) // 2)
-    cand_b = fq2_mul(b, x0)
-    root = t_select(is_minus_one, cand_a, cand_b)
-    ok = t_eq(fq2_sqr(root), a)
-    return root, ok
+    """Square root in Fq2. Returns (root, is_square). ONE fixed-exponent
+    chain (see _sqrt_core) instead of the classic two; the root's sign is
+    unspecified — callers normalize (sgn0 / lex flips)."""
+    is_qr, t, cf = _sqrt_core(a)
+    root = fq2_mul(fq2_mul(t, a), cf)
+    return root, is_qr
+
+
+def fq2_sqrt_ratio(u, v):
+    """RFC 9380 sqrt_ratio in Fq2: (b, y) with y^2 = u/v when b else Z·u/v
+    (Z the sswu nonresidue). One chain on w = u·v^3; y = t·u·v·cf — the
+    exponents are arranged so no division is needed at all."""
+    v2 = fq2_sqr(v)
+    uv = fq2_mul(u, v)
+    w = fq2_mul(uv, v2)                            # u v^3
+    is_qr, t, cf = _sqrt_core(w)
+    y = fq2_mul(fq2_mul(t, uv), cf)
+    return is_qr, y
 
 
 # Stacked many-muls: k independent fq2 products in one kernel (for curve formulas).

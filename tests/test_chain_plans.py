@@ -1,0 +1,213 @@
+"""Fixed-scalar plan compiler (ops/bls/chain_plans) vs the pure-Python oracle.
+
+Covers the host-side recoding/schedules (exact scalar reconstruction, window
+cost model), the point-chain executor on G1 AND G2 for the production fixed
+scalars (|x|, the Budroni–Pintore cofactor terms, the GLV u^2 chain) with
+negative scalars, zero, and infinity inputs, the joint field-chain executor
+(per-lane exponents), the one-chain Fq2 sqrt/sqrt_ratio, and the fused
+random+fixed windowed ladder used by the verification prologue — all under
+BOTH convolution backends (LIGHTHOUSE_CONV_IMPL), mirroring the dual-backend
+discipline of test_bls_kernels.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lighthouse_tpu  # noqa: F401
+from lighthouse_tpu.ops.bls import chain_plans as cp
+from lighthouse_tpu.ops.bls import curve, fq, g1, g2, tower
+from lighthouse_tpu.ops.bls_oracle import curves as OC
+from lighthouse_tpu.ops.bls_oracle import fields as of
+from lighthouse_tpu.ops.bls_oracle.hash_to_curve import SSWU_Z
+
+pytestmark = pytest.mark.slow  # nightly tier: exhaustive kernel parity
+
+rng = random.Random(0xC4A1)
+
+X = of.BLS_X  # negative
+FIXED_SCALARS = [
+    -X,               # |x| (subgroup chains)
+    X,                # negative scalar through the plan
+    X * X - X - 1,    # Budroni–Pintore combined term (dense)
+    X - 1,            # psi-chain term
+    X * X,            # GLV u^2 (g1 subgroup check)
+    0,
+    1,
+    7,
+]
+
+
+@pytest.fixture(
+    autouse=True, params=["f64", "digits"], ids=["conv-f64", "conv-digits"]
+)
+def conv_impl(request, monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_CONV_IMPL", request.param)
+    old = fq._CONV_IMPL
+    fq._CONV_IMPL = None
+    yield request.param
+    fq._CONV_IMPL = old
+
+
+def _reconstruct(schedule: cp.ChainSchedule, chain: int) -> int:
+    """Replay a schedule symbolically: runs are doubling counts (bits), the
+    add step contributes the column digit — for signed and unsigned alike."""
+    val = schedule.segments[0][1][chain]
+    for run, col in schedule.segments[1:]:
+        val = (val << run) + col[chain]
+    return -val if schedule.negate[chain] else val
+
+
+class TestSchedules:
+    def test_schedules_reconstruct_scalars(self):
+        for e in FIXED_SCALARS + [rng.getrandbits(127) for _ in range(4)]:
+            for window in (None, 1, 4):
+                s = cp.compile_chains((e,), window=window)
+                assert _reconstruct(s, 0) == e, (hex(e), window)
+
+    def test_sparse_scalars_stay_cheap(self):
+        s = cp.compile_chains((-X,))
+        # |x| has weight 6; the plan must not be worse than plain binary
+        assert s.n_doublings <= 63 and s.n_adds <= 6
+        assert len(s.table_slots()) <= 8
+
+    def test_joint_schedule_covers_all_chains(self):
+        s = cp.compile_chains((X * X - X - 1, X - 1))
+        assert s.n_chains == 2
+        assert s.n_doublings <= 127
+
+    def test_wnaf_digits_identity(self):
+        for w in (1, 2, 4, 5):
+            for e in (0, 1, -0 + 12345, (-X) ** 2, rng.getrandbits(96)):
+                d = cp.wnaf_digits(abs(e), w)
+                assert sum(v << i for i, v in enumerate(d)) == abs(e)
+                if w > 1:
+                    assert all(v == 0 or v % 2 for v in d)
+                    assert all(abs(v) < 1 << (w - 1) for v in d)
+
+
+def rand_g1(n):
+    return [
+        OC.g1_mul(OC.g1_generator(), rng.randrange(1, 2**63)) for _ in range(n)
+    ]
+
+
+def rand_g2(n):
+    return [
+        OC.g2_mul(OC.g2_generator(), rng.randrange(1, 2**63)) for _ in range(n)
+    ]
+
+
+class TestPointChains:
+    def test_g2_fixed_scalars_match_oracle(self):
+        pts = rand_g2(2)
+        P_ = g2.from_oracle_batch(pts)
+        for e in FIXED_SCALARS:
+            got = jax.jit(lambda p, e=e: curve.scale_fixed(2, p, e))(P_)
+            for i, po in enumerate(pts):
+                assert g2.to_oracle(got[i]) == OC.g2_mul(po, e % OC.R), hex(e)
+
+    def test_g1_fixed_scalars_match_oracle(self):
+        pts = rand_g1(2)
+        P_ = g1.from_oracle_batch(pts)
+        for e in (-X, X * X, -7, 0):
+            got = jax.jit(lambda p, e=e: curve.scale_fixed(1, p, e))(P_)
+            for i, po in enumerate(pts):
+                assert g1.to_oracle(got[i]) == OC.g1_mul(po, e % OC.R), hex(e)
+
+    def test_infinity_input_stays_infinity(self):
+        inf = jnp.broadcast_to(curve.inf_point(2), (3, 6, fq.NLIMBS))
+        out = jax.jit(lambda p: curve.scale_fixed(2, p, X * X - X - 1))(inf)
+        assert np.asarray(g2.is_inf(out)).all()
+
+    def test_joint_chains_one_scan(self):
+        pts = rand_g2(2)
+        P_ = jnp.stack([g2.from_oracle_batch(pts)] * 2)
+        es = (X * X - X - 1, X - 1)
+        sched = cp.compile_chains(es)
+        out = jax.jit(lambda p: cp.run_point_chains(2, p, sched))(P_)
+        for c, e in enumerate(es):
+            for i, po in enumerate(pts):
+                assert g2.to_oracle(out[c, i]) == OC.g2_mul(po, e % OC.R)
+
+    def test_subgroup_checks_still_sound(self):
+        good = g2.from_oracle_batch(rand_g2(2))
+        assert np.asarray(jax.jit(g2.subgroup_check)(good)).all()
+        goodg1 = g1.from_oracle_batch(rand_g1(2))
+        assert np.asarray(jax.jit(g1.subgroup_check)(goodg1)).all()
+
+
+class TestFusedU64:
+    def test_scale_u64_windowed_matches_oracle(self):
+        pts = rand_g2(3)
+        ks = np.array(
+            [1, 2**64 - 1, rng.getrandbits(64) or 1], dtype=np.uint64
+        )
+        M = jax.jit(lambda p, s: curve.scale_u64(2, p, s))(
+            g2.from_oracle_batch(pts), jnp.asarray(ks)
+        )
+        for i in range(3):
+            assert g2.to_oracle(M[i]) == OC.g2_mul(pts[i], int(ks[i]))
+
+    def test_fused_fixed_lane_matches_separate(self):
+        pts = rand_g2(2)
+        P_ = g2.from_oracle_batch(pts)
+        ks = np.array([5, rng.getrandbits(64) or 1], dtype=np.uint64)
+        accs = jax.jit(
+            lambda p, s: curve.scale_u64_with_fixed(2, p, s, (-X,))
+        )(P_, jnp.asarray(ks))
+        for i in range(2):
+            assert g2.to_oracle(accs[0, i]) == OC.g2_mul(pts[i], int(ks[i]))
+            assert g2.to_oracle(accs[1, i]) == OC.g2_mul(pts[i], -X)
+
+
+class TestFieldChains:
+    def test_joint_exponent_lanes(self):
+        e0, e1 = 0xDEADBEEFCAFE, (1 << 200) + 12345
+        sched = cp.compile_chains((e0, e1), signed=False)
+        xs = [rng.randrange(of.P) for _ in range(3)]
+        A = fq.from_ints(xs)[:, None, :]
+        bases = jnp.stack([A, A])
+        out = jax.jit(
+            lambda b: cp.run_field_chains(
+                sched, b, fq.mont_sqr_lazy, fq.mont_mul_lazy, tower.one(1)
+            )
+        )(bases)
+        for lane, e in ((0, e0), (1, e1)):
+            for i, x in enumerate(xs):
+                assert fq.to_int(np.asarray(out[lane, i, 0])) == pow(x, e, of.P)
+
+    def test_fq2_sqrt_one_chain(self):
+        cases = []
+        for _ in range(3):
+            s = of.Fq2(rng.randrange(of.P), rng.randrange(of.P))
+            cases.append(s.square())           # QR
+            cases.append(s.square() * SSWU_Z)  # non-QR
+        cases.append(of.Fq2(0, 0))
+        A = jnp.stack([tower.fq2_from_oracle(c) for c in cases])
+        root, ok = jax.jit(tower.fq2_sqrt)(A)
+        for i, c in enumerate(cases):
+            want = (c.sqrt() is not None) or c.is_zero()
+            assert bool(np.asarray(ok)[i]) == want
+            if want:
+                r = tower.fq2_to_oracle(root[i])
+                assert r * r == c
+
+    def test_fq2_sqrt_ratio(self):
+        us = [of.Fq2(rng.randrange(of.P), rng.randrange(of.P)) for _ in range(4)]
+        vs = [of.Fq2(rng.randrange(of.P), rng.randrange(of.P)) for _ in range(4)]
+        U = jnp.stack([tower.fq2_from_oracle(c) for c in us])
+        V = jnp.stack([tower.fq2_from_oracle(c) for c in vs])
+        b, y = jax.jit(tower.fq2_sqrt_ratio)(U, V)
+        for i, (u, v) in enumerate(zip(us, vs)):
+            ratio = u * v.inv()
+            yo = tower.fq2_to_oracle(y[i])
+            if bool(np.asarray(b)[i]):
+                assert yo * yo == ratio
+            else:
+                assert ratio.sqrt() is None
+                assert yo * yo == SSWU_Z * ratio

@@ -11,7 +11,15 @@ import pytest
 
 from lighthouse_tpu import bls, tools
 from lighthouse_tpu.cli import main as cli_main
+from lighthouse_tpu.keys import keystore as _keystore
 from lighthouse_tpu.types.spec import minimal_spec
+
+# EIP-2335 keystore encryption needs the gated 'cryptography' package —
+# skip (not fail) in environments without it, like test_keys_and_vc
+requires_aes = pytest.mark.skipif(
+    not _keystore._HAVE_CRYPTOGRAPHY,
+    reason="cryptography package unavailable (AES-128-CTR keystore paths)",
+)
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -97,6 +105,7 @@ def test_lcli_skip_slots_and_transition(chain_dir, tmp_path):
     assert pretty["message"]["slot"] == chain.head.slot
 
 
+@requires_aes
 def test_validator_manager_roundtrip(tmp_path):
     from lighthouse_tpu.validator_client import KeymanagerServer, ValidatorStore
 

@@ -2,13 +2,18 @@
 
 Times jax trace (.lower()) and XLA compile (.compile()) separately for each
 stage of the fused kernel at a given (sets, keys) shape, plus HLO op/while
-counts — the instrument for the round-4 compile-time attack (VERDICT r3 #1).
+counts — the instrument for the round-4 compile-time attack (VERDICT r3 #1)
+and the guard against a repeat of it: ``--json`` appends one machine-
+comparable JSON line per run ({stage: {trace_s, compile_s, hlo_lines,
+while_ops}}), so before/after records of e.g. the h2c and prologue stages
+can be diffed across commits (see COMPILE_PROBE_r06.log).
 
-Usage: python tools_compile_probe.py [n_sets] [k_keys] [stage ...]
+Usage: python tools_compile_probe.py [--json] [n_sets] [k_keys] [stage ...]
 """
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 
@@ -17,6 +22,8 @@ import devcpu  # noqa: F401  (CPU platform before jax init)
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_RESULTS: dict = {}
 
 
 def _hlo_stats(lowered):
@@ -39,13 +46,21 @@ def probe(name, fn, *args):
         f"hlo_lines {n_lines:7d}  while_ops {n_while:4d}",
         flush=True,
     )
+    _RESULTS[name] = {
+        "trace_s": round(t_trace, 2),
+        "compile_s": round(t_compile, 2),
+        "hlo_lines": n_lines,
+        "while_ops": n_while,
+    }
     return compiled
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
-    k = int(sys.argv[2]) if len(sys.argv) > 2 else 64
-    only = set(sys.argv[3:])
+    args = [a for a in sys.argv[1:] if a != "--json"]
+    emit_json = "--json" in sys.argv
+    n = int(args[0]) if len(args) > 0 else 16
+    k = int(args[1]) if len(args) > 1 else 64
+    only = set(args[2:])
 
     from lighthouse_tpu.ops.bls import curve, g1, g2, h2c, pairing
     from lighthouse_tpu.bls import tpu_backend as tb
@@ -115,6 +130,26 @@ def main():
                 f"hlo_lines {txt.count(chr(10)):7d}",
                 flush=True,
             )
+            _RESULTS[f"fused.{st_name}"] = {
+                "compile_s": round(t_compile, 2),
+                "hlo_lines": txt.count(chr(10)),
+            }
+    if emit_json:
+        import subprocess
+
+        try:
+            head = (
+                subprocess.run(
+                    ["git", "rev-parse", "--short", "HEAD"],
+                    capture_output=True, timeout=10,
+                ).stdout.decode().strip()
+            )
+        except Exception:  # noqa: BLE001
+            head = "unknown"
+        print(json.dumps(
+            {"shape": {"sets": n, "keys": k}, "git_head": head,
+             "stages": _RESULTS}
+        ))
 
 
 if __name__ == "__main__":

@@ -44,6 +44,7 @@ STATE = os.path.join(CACHE, "hunter_state.json")
 RECORD = os.path.join(CACHE, "tpu_record.json")
 RECORD_FIREHOSE = os.path.join(CACHE, "tpu_firehose_record.json")
 RECORD_EPOCH = os.path.join(CACHE, "tpu_epoch_record.json")
+RECORD_H2C = os.path.join(CACHE, "tpu_h2c_record.json")
 RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
@@ -70,6 +71,9 @@ RUNGS.insert(
     + bench._FIREHOSE_RUNG[5:],
 )
 RUNGS.insert(2, bench._EPOCH_RUNG_SMALL)
+# h2c micro-rung (smallest program of the ladder — compile-warm via
+# .jax_cache): isolated hash-to-curve points/s + per-stage chain timings
+RUNGS.insert(1, bench._H2C_RUNG_SMALL)
 RUNGS.append(bench._EPOCH_RUNG_FULL)
 
 
@@ -149,6 +153,7 @@ def persist(rec: dict, rung_idx: int) -> None:
     record_path = {
         "firehose_attestations_verified_per_s": RECORD_FIREHOSE,
         "epoch_validators_per_s": RECORD_EPOCH,
+        "h2c_points_per_s": RECORD_H2C,
     }.get(rec.get("metric"), RECORD)
     best = None
     try:
