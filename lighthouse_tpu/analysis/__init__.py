@@ -1,0 +1,26 @@
+"""Static-analysis subsystem: machine-checked kernel certification.
+
+Three passes, run in tier-1 CI (``tests/test_analysis.py``), by the TPU
+window hunter's preflight (``tools_tpu_hunter.py``), and by hand via
+``python -m lighthouse_tpu.analysis``:
+
+* **Pass 1 — limb-bound certifier** (``bounds.py``): re-executes every
+  fq/fq2 op graph abstractly (``jax.eval_shape``) with a certification sink
+  installed in ``ops/bls/fq.py``/``plans.py``, so every statically-derived
+  bound — f64/f32 convolution exactness, u32/u64 accumulator wrap safety,
+  reduction-walk targets, lazy ``CHAIN_BOUND`` fixed points — is recorded
+  as a (proven, declared) proof obligation per conv backend. Emits
+  ``BOUNDS_CERT.json``; any unproven edge fails the pass loudly.
+* **Pass 2 — trace-hygiene linter** (``hygiene.py``): an AST pass over
+  ``lighthouse_tpu/`` flagging jit anti-patterns (host syncs, Python
+  branches on tracers, unhashable static-argnum values, impure closures)
+  with a ``# lint: allow(<rule>)`` pragma and a checked-in baseline.
+* **Pass 3 — recompilation sentinel** (``recompile.py``): a
+  compilation-count hook (``jax_log_compiles`` capture) asserting that
+  steady-state loops — the firehose verify pipeline, the epoch-engine
+  sweep — trigger ZERO recompiles after warm-up.
+"""
+
+from .bounds import certify, certify_callable, write_cert  # noqa: F401
+from .hygiene import lint_tree  # noqa: F401
+from .recompile import CompilationSentinel, steady_state_compiles  # noqa: F401

@@ -1,0 +1,112 @@
+"""``python -m lighthouse_tpu.analysis`` — run the kernel certifier + linter.
+
+Exit code 0 iff every selected pass is clean. ``--json`` emits one machine-
+readable report on stdout (the hunter preflight consumes it); the default
+output is human-oriented. The recompilation sentinel is a *runtime* hook
+(it needs a live loop to watch), so it is exercised by tests/test_analysis.py
+and the bench rungs rather than by this CLI; ``--bounds``/``--lint`` select
+passes, default is both.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="lighthouse_tpu.analysis")
+    ap.add_argument("--json", action="store_true", help="machine-readable report")
+    ap.add_argument("--bounds", action="store_true", help="run only the limb-bound certifier")
+    ap.add_argument("--lint", action="store_true", help="run only the trace-hygiene linter")
+    ap.add_argument(
+        "--cert-out",
+        default=None,
+        help="write BOUNDS_CERT.json here (default: repo root when the bounds"
+        " pass runs, '-' to skip)",
+    )
+    ap.add_argument(
+        "--graphs", nargs="*", default=None,
+        help="restrict certification to graphs whose name contains any substring",
+    )
+    ap.add_argument(
+        "--batches", nargs="*", type=int, default=None,
+        help="batch regimes to certify (default 1 32)",
+    )
+    args = ap.parse_args(argv)
+    run_bounds = args.bounds or not args.lint
+    run_lint = args.lint or not args.bounds
+
+    report: dict = {"ok": True}
+    rc = 0
+
+    if run_lint:
+        from .hygiene import lint_tree
+
+        findings, suppressed = lint_tree()
+        report["lint"] = {
+            "ok": not findings,
+            "n_findings": len(findings),
+            "n_baseline_suppressed": suppressed,
+            "findings": [f.as_dict() for f in findings],
+        }
+        if findings:
+            report["ok"] = False
+            rc = 1
+        if not args.json:
+            for f in findings:
+                print(str(f), file=sys.stderr)
+            print(
+                f"lint: {len(findings)} finding(s), {suppressed} baseline-"
+                f"suppressed — {'FAIL' if findings else 'ok'}",
+                file=sys.stderr,
+            )
+
+    if run_bounds:
+        from .bounds import certify, write_cert
+
+        kw = {}
+        if args.batches:
+            kw["batches"] = tuple(args.batches)
+        cert = certify(graphs=args.graphs, **kw)
+        out = args.cert_out
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(
+                    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+                ),
+                "BOUNDS_CERT.json",
+            )
+        if out != "-":
+            write_cert(cert, out)
+        report["bounds"] = {
+            "ok": cert["ok"],
+            "n_obligations": cert["n_obligations"],
+            "n_failed": cert["n_failed"],
+            "min_margin_bits": cert["min_margin_bits"],
+            "cert_path": None if out == "-" else out,
+        }
+        if not cert["ok"]:
+            report["ok"] = False
+            rc = 1
+        if not args.json:
+            for r in cert["obligations"]:
+                if not r["ok"]:
+                    print(f"UNPROVEN {r}", file=sys.stderr)
+            print(
+                f"bounds: {cert['n_obligations']} obligations,"
+                f" {cert['n_failed']} failed, min margin"
+                f" {cert['min_margin_bits']} bits —"
+                f" {'ok' if cert['ok'] else 'FAIL'}",
+                file=sys.stderr,
+            )
+
+    if args.json:
+        print(json.dumps(report))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,293 @@
+"""Pass 1 — the limb-bound certifier: static proofs for the BLS limb stack.
+
+The kernel code already derives every bound it relies on statically at trace
+time (``fq._RState`` walks the reduction schedule, ``plans._Bound`` composes
+through lincombs) and asserts them. What it did NOT do is (a) surface those
+proofs as an auditable artifact, or (b) run them for backends other than the
+one the current process uses. This module does both:
+
+* ``ops/bls/fq.py`` exposes a certification sink (``fq._CERT_SINK``); with a
+  sink installed, every statically-proved obligation — conv-accumulator
+  exactness (f64 < 2^53, f32 digits < 2^24), u32/u64 wrap safety, fold
+  accumulators, carry-walk widths, reduction-walk value/limb/top targets,
+  lincomb budgets, wide out-row accumulators, declared ``out_bound``
+  soundness — is recorded as a ``(kind, proven, declared-limit)`` record.
+* ``certify()`` re-executes the whole public op-graph surface (fq tower
+  curve h2c chain_plans pairing) **abstractly** via ``jax.eval_shape`` — no
+  compilation, no numerics, just the Python trace that runs the bound
+  machinery — once per requested conv backend (``LIGHTHOUSE_CONV_IMPL``
+  semantics) and per batch regime (the f64 backend statically dispatches a
+  different walk above ``fq.F64_WALK_MIN_ROWS`` rows, so both dispatch modes
+  are certified).
+* An ``AssertionError`` raised by the bound machinery during a graph trace
+  is NOT a certifier crash: it is recorded as an unproven edge and fails
+  the certificate — this is how seeded mutations (e.g. a lazy interior
+  widened by one squaring) and the known-bad fixture kernels are flagged.
+
+The certificate is written to ``BOUNDS_CERT.json`` (see the README section
+"Static analysis & kernel certification" for how to read it).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+
+__all__ = ["certify", "certify_callable", "write_cert", "CertSink"]
+
+
+def _bits(x: int) -> float:
+    """log2 of a non-negative int, exact-ish for huge values."""
+    if x <= 0:
+        return 0.0
+    return round(math.log2(x), 2) if x < 1 << 1000 else float(x.bit_length())
+
+
+class CertSink:
+    """Collects proof obligations recorded by ``fq._cert``; deduplicates
+    identical (graph, kind, note, proven, limit) records into counts."""
+
+    def __init__(self):
+        self.obligations: dict[tuple, dict] = {}
+        self._ctx: list[str] = []
+
+    @property
+    def graph(self) -> str:
+        return "/".join(self._ctx) or "<module>"
+
+    @contextlib.contextmanager
+    def context(self, label: str):
+        self._ctx.append(label)
+        try:
+            yield
+        finally:
+            self._ctx.pop()
+
+    def record(self, kind: str, proven, limit, note: str = "", ok=None) -> None:
+        proven = int(proven)
+        limit = int(limit)
+        if ok is None:
+            ok = proven <= limit
+        key = (self.graph, kind, note, proven, limit)
+        rec = self.obligations.get(key)
+        if rec is None:
+            self.obligations[key] = {
+                "graph": self.graph,
+                "kind": kind,
+                "site": note,
+                "proven_bits": _bits(proven),
+                "limit_bits": _bits(limit),
+                "margin_bits": round(_bits(limit) - _bits(proven), 2),
+                "ok": bool(ok),
+                "count": 1,
+            }
+        else:
+            rec["count"] += 1
+
+    def fail(self, kind: str, error: str) -> None:
+        """Record an unproven edge (a bound assert tripped mid-trace)."""
+        key = (self.graph, kind, error, -1, -1)
+        rec = self.obligations.setdefault(
+            key,
+            {
+                "graph": self.graph,
+                "kind": kind,
+                "site": "",
+                "error": error,
+                "ok": False,
+                "count": 0,
+            },
+        )
+        rec["count"] += 1
+
+    def rows(self) -> list[dict]:
+        return sorted(
+            self.obligations.values(),
+            key=lambda r: (r["ok"], r.get("margin_bits", -1.0), r["graph"]),
+        )
+
+
+@contextlib.contextmanager
+def _sink_installed(sink: CertSink):
+    from ..ops.bls import fq
+
+    prev = fq._CERT_SINK
+    fq._CERT_SINK = sink
+    try:
+        yield
+    finally:
+        fq._CERT_SINK = prev
+
+
+@contextlib.contextmanager
+def _forced_backend(impl: str):
+    """Force the conv backend for the duration (the certifier proves bounds
+    for backends the current process does not run on)."""
+    from ..ops.bls import fq
+
+    prev = fq._CONV_IMPL
+    fq._CONV_IMPL = impl
+    try:
+        yield
+    finally:
+        fq._CONV_IMPL = prev
+
+
+# --------------------------------------------------------------------------------------
+# Op-graph registry: the public kernel surface, per batch size
+# --------------------------------------------------------------------------------------
+
+
+def graph_registry(batch: int) -> list[tuple]:
+    """(name, fn, arg-specs) for every op graph the certifier re-executes.
+    Specs are ShapeDtypeStructs — eval_shape never materializes arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.bls import curve, fq, h2c, pairing, tower
+    from ..ops.bls_oracle.fields import BLS_X
+
+    u64 = jnp.uint64
+    B = (batch,)
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(B + shape, u64)
+
+    e1, e2, e6, e12 = s(25), s(2, 25), s(6, 25), s(12, 25)
+    p1, p2 = s(3, 25), s(6, 25)
+    sc = jax.ShapeDtypeStruct(B, u64)
+
+    def g(k, f):
+        return functools.partial(f, k)
+
+    return [
+        # fq.py — base-field multiply pipeline, reductions, fixed chains
+        ("fq.mont_mul", fq.mont_mul, (e1, e1)),
+        ("fq.mont_sqr", fq.mont_sqr, (e1,)),
+        ("fq.mont_mul_lazy", fq.mont_mul_lazy, (e1, e1)),
+        ("fq.canonical", fq.canonical, (e1,)),
+        ("fq.inv", fq.inv, (e1,)),
+        ("fq.sqrt_candidate", fq.sqrt_candidate, (e1,)),
+        ("fq.lex_gt_half", fq.lex_gt_half, (e1,)),
+        # tower.py — fq2/fq6/fq12 plan-compiled ops + the sqrt chains
+        ("tower.fq2_mul", tower.fq2_mul, (e2, e2)),
+        ("tower.fq2_sqr", tower.fq2_sqr, (e2,)),
+        ("tower.fq2_mul_lazy", tower.fq2_mul_lazy, (e2, e2)),
+        ("tower.fq2_sqr_lazy", tower.fq2_sqr_lazy, (e2,)),
+        ("tower.fq2_inv", tower.fq2_inv, (e2,)),
+        ("tower.fq2_sqrt", tower.fq2_sqrt, (e2,)),
+        ("tower.fq2_sqrt_ratio", tower.fq2_sqrt_ratio, (e2, e2)),
+        ("tower.fq2_mul_many4", lambda a, b: tower.fq2_mul_many(
+            [(a, b), (b, a), (a, a), (b, b)]), (e2, e2)),
+        ("tower.fq6_mul", tower.fq6_mul, (e6, e6)),
+        ("tower.fq6_inv", tower.fq6_inv, (e6,)),
+        ("tower.fq12_mul", tower.fq12_mul, (e12, e12)),
+        ("tower.fq12_sqr", tower.fq12_sqr, (e12,)),
+        ("tower.fq12_inv", tower.fq12_inv, (e12,)),
+        ("tower.fq12_frobenius1", tower.fq12_frobenius1, (e12,)),
+        ("tower.fq12_cyclotomic_sqr", tower.fq12_cyclotomic_sqr, (e12,)),
+        ("tower.fq12_cyclotomic_exp_abs_x",
+         tower.fq12_cyclotomic_exp_abs_x, (e12,)),
+        ("tower.t_eq12", tower.t_eq, (e12, e12)),
+        # curve.py — complete formulas, scalar multiplication (chain_plans)
+        ("curve.point_add.g1", g(1, curve.point_add), (p1, p1)),
+        ("curve.point_dbl.g1", g(1, curve.point_dbl), (p1,)),
+        ("curve.point_add.g2", g(2, curve.point_add), (p2, p2)),
+        ("curve.point_dbl.g2", g(2, curve.point_dbl), (p2,)),
+        ("curve.point_eq.g2", g(2, curve.point_eq), (p2, p2)),
+        ("curve.to_affine.g2", g(2, curve.to_affine), (p2,)),
+        ("curve.scale_fixed_x.g2",
+         lambda p: curve.scale_fixed(2, p, BLS_X), (p2,)),
+        ("curve.scale_u64_with_fixed.g2",
+         lambda p, r: curve.scale_u64_with_fixed(2, p, r, (-BLS_X,)),
+         (p2, sc)),
+        # h2c.py — SSWU fraction form, isogeny, cofactor clearing
+        ("h2c.map_to_g2", h2c.map_to_g2, (e2, e2)),
+        # pairing.py — Miller loop, sparse fold, final exponentiation
+        ("pairing.mul_by_014", pairing.mul_by_014, (e12, e6)),
+        ("pairing.miller_loop", pairing.miller_loop, (e1, e1, e2, e2)),
+        ("pairing.final_exponentiation",
+         pairing.final_exponentiation, (e12,)),
+    ]
+
+
+# Batch regimes: the f64 backend statically dispatches the u64 walk below
+# fq.F64_WALK_MIN_ROWS rows and the all-f64 walk at/above it — certify both.
+_DEFAULT_BATCHES = (1, 32)
+_DEFAULT_BACKENDS = ("f64", "digits")
+
+
+def _trace_graph(sink: CertSink, name: str, fn, specs) -> None:
+    import jax
+
+    with sink.context(name):
+        try:
+            # a fresh wrapper per trace: eval_shape's trace cache is keyed
+            # by function identity + avals, NOT the forced conv backend —
+            # passing `fn` directly would silently skip the re-trace (and
+            # every obligation record) for each backend after the first
+            jax.eval_shape(lambda *a: fn(*a), *specs)
+        except AssertionError as e:
+            sink.fail("unproven_bound", str(e) or "AssertionError")
+        except Exception as e:  # noqa: BLE001 — a broken graph is a finding
+            sink.fail("trace_error", f"{type(e).__name__}: {e}")
+
+
+def certify_callable(fn, specs, backend: str = "f64") -> list[dict]:
+    """Certify ONE callable's bound obligations under ``backend`` (fixture
+    corpus / mutation tests). Returns the obligation rows."""
+    sink = CertSink()
+    with _sink_installed(sink), _forced_backend(backend):
+        _trace_graph(sink, getattr(fn, "__name__", "callable"), fn, specs)
+    return sink.rows()
+
+
+def certify(
+    backends=_DEFAULT_BACKENDS,
+    batches=_DEFAULT_BATCHES,
+    graphs=None,
+) -> dict:
+    """Run the full certificate: every registry graph x conv backend x batch
+    regime. ``graphs`` optionally restricts to names containing any of the
+    given substrings. Returns the certificate dict (see write_cert)."""
+    from ..ops.bls import plans
+
+    sink = CertSink()
+    with _sink_installed(sink):
+        # the carry_norm schedule proof (normally an import-time check)
+        with sink.context("plans.carry_norm_schedule"):
+            try:
+                plans._verify_carry_norm_schedule(plans._CARRY_NORM_FOLDS)
+            except AssertionError as e:
+                sink.fail("unproven_bound", str(e))
+        for backend in backends:
+            with _forced_backend(backend):
+                for batch in batches:
+                    regime = f"{backend}@b{batch}"
+                    for name, fn, specs in graph_registry(batch):
+                        if graphs and not any(s in name for s in graphs):
+                            continue
+                        _trace_graph(sink, f"{regime}/{name}", fn, specs)
+    rows = sink.rows()
+    failed = [r for r in rows if not r["ok"]]
+    margins = [r["margin_bits"] for r in rows if "margin_bits" in r]
+    return {
+        "version": 1,
+        "tool": "python -m lighthouse_tpu.analysis --bounds",
+        "backends": list(backends),
+        "batches": list(batches),
+        "ok": not failed,
+        "n_obligations": len(rows),
+        "n_failed": len(failed),
+        "min_margin_bits": min(margins) if margins else None,
+        "obligations": rows,
+    }
+
+
+def write_cert(cert: dict, path: str) -> None:
+    import json
+
+    with open(path, "w") as f:
+        json.dump(cert, f, indent=1)
+        f.write("\n")

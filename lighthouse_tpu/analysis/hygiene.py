@@ -1,0 +1,565 @@
+"""Pass 2 — the trace-hygiene linter: AST checks for jit anti-patterns.
+
+Rules (all scoped to *jit scopes* — functions decorated with ``jax.jit`` /
+``functools.partial(jax.jit, ...)``, functions passed to ``jax.jit`` or
+``shard_map`` by name (including through ``functools.partial``), bodies
+handed to ``lax.scan`` / ``fori_loop`` / ``while_loop`` /
+``associative_scan`` / ``vmap``, and any ``def``/``lambda`` nested inside
+one):
+
+* ``host-sync`` — ``.item()`` / ``.tolist()`` calls, ``float()`` /
+  ``int()`` / ``bool()`` on traced values, ``np.asarray`` / ``np.array``
+  of traced values: each forces a device->host transfer and a pipeline
+  stall inside a traced body.
+* ``tracer-branch`` — Python ``if``/``while`` whose test reads a traced
+  value (a jit-scope parameter or anything data-derived from one). Shape /
+  dtype / ndim reads are static and exempt; statically-bound partial args
+  (``functools.partial(body, consts)`` under ``jax.jit``) are exempt.
+* ``static-unhashable`` — a call site passing a list/dict/set literal (or
+  ``np.array(...)``) for a parameter the callee declares in
+  ``static_argnums``/``static_argnames`` — an unhashable static blows up
+  at runtime with a cryptic error, or worse, retriggers compilation.
+* ``impure-closure`` — ``global``/``nonlocal`` writes, mutation of closure
+  state (``.append``/``.update``/item-assignment on names defined outside
+  the jit scope), and impure host calls (``time.*``, ``secrets.*``,
+  ``random.*``, ``os.environ``, ``open``) inside a traced body: they run
+  once at trace time, silently freezing or corrupting state.
+
+Intentional sites carry a ``# lint: allow(<rule>)`` pragma on the flagged
+line (or the line above) with a justification comment; whole-finding
+exceptions can also live in the checked-in baseline
+(``analysis/hygiene_baseline.json``, keyed by (path, rule, source line) so
+line-number churn does not invalidate it).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+
+__all__ = ["Finding", "lint_file", "lint_tree", "RULES", "load_baseline"]
+
+RULES = {
+    "host-sync": "device->host sync inside a traced body",
+    "tracer-branch": "Python control flow on a traced value",
+    "static-unhashable": "unhashable value passed for a static argnum/argname",
+    "impure-closure": "side effect / impure host call inside a traced body",
+}
+
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\(([a-z\-,\s]+)\)")
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+_MUTATORS = {
+    "append", "extend", "add", "update", "pop", "popleft", "appendleft",
+    "insert", "remove", "clear", "setdefault", "write",
+}
+_IMPURE_CALLS = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("time", "sleep"), ("os", "environ"), ("os", "getenv"),
+}
+_IMPURE_MODULES = {"secrets", "random"}
+_HOST_CAST_FNS = {"float", "int", "bool", "complex"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    context: str  # stripped source line (the baseline key)
+
+    def key(self) -> tuple:
+        return (self.path, self.rule, self.context)
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "rule": self.rule,
+            "message": self.message, "context": self.context,
+        }
+
+    def __str__(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.rule}] {self.message}\n"
+            f"    {self.context}\n"
+            f"    (intentional? append  # lint: allow({self.rule}))"
+        )
+
+
+def _dotted(node) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node) -> bool:
+    """Does this expression denote jax.jit (or a partial of it)?"""
+    d = _dotted(node)
+    if d in ("jit", "jax.jit"):
+        return True
+    if isinstance(node, ast.Call):
+        cd = _dotted(node.func)
+        if cd in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        # jax.jit(...) used as a decorator factory
+        if cd in ("jit", "jax.jit"):
+            return True
+    return False
+
+
+def _static_spec_from_call(call: ast.Call) -> tuple[tuple, tuple]:
+    """(static_argnums, static_argnames) literals from a jit(...) call."""
+    nums, names = (), ()
+    for kw in call.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        if kw.arg == "static_argnums":
+            nums = tuple(val) if isinstance(val, (tuple, list)) else (val,)
+        elif kw.arg == "static_argnames":
+            names = (val,) if isinstance(val, str) else tuple(val)
+    return nums, names
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """First pass: which names are traced (jit roots, lax bodies), how many
+    leading params are statically bound, and which functions declare
+    static argnums/argnames."""
+
+    # function-position argument index per lax-style combinator
+    _BODY_ARGS = {
+        "scan": (0,), "associative_scan": (0,), "fori_loop": (2,),
+        "while_loop": (0, 1), "vmap": (0,), "pmap": (0,), "shard_map": (0,),
+        "checkpoint": (0,), "remat": (0,), "custom_jvp": (0,),
+        "eval_shape": (0,),
+    }
+
+    def __init__(self):
+        self.traced: dict[str, int] = {}   # func name -> n leading bound args
+        self.traced_lambdas: set[ast.Lambda] = set()
+        self.static_specs: dict[str, tuple] = {}  # name -> (nums, names)
+        self.aliases: dict[str, set[str]] = {}  # name -> names it may denote
+
+    def resolve_aliases(self) -> None:
+        """`body = _sweep_a if cond else _sweep_b; jax.jit(partial(body, c))`
+        marks `body`; propagate the marking to the functions it denotes."""
+        for _ in range(4):  # alias chains are shallow; fixpoint quickly
+            changed = False
+            for name, bound in list(self.traced.items()):
+                for target in self.aliases.get(name, ()):
+                    prev = self.traced.get(target)
+                    nb = bound if prev is None else min(prev, bound)
+                    if prev != nb:
+                        self.traced[target] = nb
+                        changed = True
+            if not changed:
+                return
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        names = [
+            n.id
+            for n in ast.walk(node.value)
+            if isinstance(n, ast.Name) and not n.id.startswith("jnp")
+        ]
+        if names and isinstance(node.value, (ast.Name, ast.IfExp)):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.aliases.setdefault(tgt.id, set()).update(names)
+        self.generic_visit(node)
+
+    def _mark(self, node, bound: int = 0) -> None:
+        if isinstance(node, ast.Name):
+            prev = self.traced.get(node.id)
+            self.traced[node.id] = bound if prev is None else min(prev, bound)
+        elif isinstance(node, ast.Lambda):
+            self.traced_lambdas.add(node)
+        elif isinstance(node, ast.Call):
+            cd = _dotted(node.func)
+            if cd in ("functools.partial", "partial") and node.args:
+                self._mark(node.args[0], bound + len(node.args) - 1)
+
+    def visit_Call(self, call: ast.Call) -> None:
+        cd = _dotted(call.func)
+        if cd in ("jit", "jax.jit") and call.args:
+            self._mark(call.args[0])
+            nums, names = _static_spec_from_call(call)
+            if (nums or names) and isinstance(call.args[0], ast.Name):
+                self.static_specs[call.args[0].id] = (nums, names)
+        elif cd is not None:
+            tail = cd.rsplit(".", 1)[-1]
+            for i in self._BODY_ARGS.get(tail, ()):
+                if i < len(call.args):
+                    self._mark(call.args[i])
+        self.generic_visit(call)
+
+    def visit_FunctionDef(self, node) -> None:
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec):
+                bound = 0
+                if isinstance(dec, ast.Call):
+                    cd = _dotted(dec.func)
+                    if cd in ("functools.partial", "partial"):
+                        bound = len(dec.args) - 1
+                    nums, names = _static_spec_from_call(dec)
+                    if nums or names:
+                        self.static_specs[node.name] = (nums, names)
+                self.traced[node.name] = bound
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _TaintedUses(ast.NodeVisitor):
+    """Collect uses of tainted names in an expression, skipping static
+    contexts (``x.shape``, ``len(x)``, ``isinstance(x, ..)``)."""
+
+    def __init__(self, tainted: set[str]):
+        self.tainted = tainted
+        self.hits: list[str] = []
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _STATIC_ATTRS:
+            return  # x.shape / x.dtype reads are static
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name) and node.func.id in (
+            "len", "isinstance", "type", "getattr", "hasattr", "range",
+        ):
+            return
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.tainted:
+            self.hits.append(node.id)
+
+
+def _tainted_uses(expr, tainted: set[str]) -> list[str]:
+    v = _TaintedUses(tainted)
+    v.visit(expr)
+    return v.hits
+
+
+class _JitBodyLint:
+    """Run the rules over one jit-scope function body."""
+
+    def __init__(self, fname: str, findings: list, path: str, lines: list[str]):
+        self.findings = findings
+        self.path = path
+        self.lines = lines
+        self.fname = fname
+
+    def flag(self, node, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        ctx = self.lines[line - 1].strip() if line <= len(self.lines) else ""
+        self.findings.append(Finding(self.path, line, rule, message, ctx))
+
+    def run(self, fn, bound: int, static_spec: tuple = ((), ())) -> None:
+        # taint seeds: the traced parameters — skip statically-bound leading
+        # partial args AND declared static argnums/argnames
+        args = getattr(fn, "args", None)
+        tainted: set[str] = set()
+        local: set[str] = set()
+        if args is not None:
+            params = [a.arg for a in args.posonlyargs + args.args]
+            nums, names = static_spec
+            tainted.update(
+                p
+                for i, p in enumerate(params)
+                if i >= bound and i not in nums and p not in names
+            )
+            local.update(params)
+        body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+        self._walk(body, tainted, local)
+
+    # -- statement walk with simple forward taint propagation --------------
+
+    def _walk(self, stmts, tainted: set[str], local: set[str]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs are traced too; closure taint flows in
+                inner = _JitBodyLint(st.name, self.findings, self.path, self.lines)
+                inner_t = set(tainted) | {a.arg for a in st.args.args}
+                inner._walk(st.body, inner_t, set(local) | {a.arg for a in st.args.args})
+                local.add(st.name)
+                continue
+            if isinstance(st, (ast.Global, ast.Nonlocal)):
+                self.flag(
+                    st, "impure-closure",
+                    f"`{type(st).__name__.lower()}` write inside traced body of {self.fname}",
+                )
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                hits = _tainted_uses(st.test, tainted)
+                if hits:
+                    self.flag(
+                        st, "tracer-branch",
+                        f"Python `{'if' if isinstance(st, ast.If) else 'while'}`"
+                        f" on traced value(s) {sorted(set(hits))} in {self.fname}"
+                        " (use jnp.where / lax.cond)",
+                    )
+                self._walk(st.body, tainted, local)
+                self._walk(st.orelse, tainted, local)
+                self._scan_exprs(st.test, tainted, local)
+                continue
+            if isinstance(st, (ast.For,)):
+                # iterating a STATIC container of tracers ((a, b, c), a dict)
+                # unrolls at trace time and is idiomatic; only direct
+                # iteration over a traced array is the per-element-unroll
+                # anti-pattern
+                if (
+                    isinstance(st.iter, ast.Name)
+                    and st.iter.id in tainted
+                ):
+                    self.flag(
+                        st, "tracer-branch",
+                        f"Python `for` directly over traced `{st.iter.id}` in"
+                        f" {self.fname} (use lax.scan / fori_loop)",
+                    )
+                if isinstance(st.target, ast.Name):
+                    local.add(st.target.id)
+                self._walk(st.body, tainted, local)
+                self._walk(st.orelse, tainted, local)
+                continue
+            if isinstance(st, ast.Assign):
+                rhs_tainted = bool(_tainted_uses(st.value, tainted))
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Name):
+                        local.add(tgt.id)
+                        if rhs_tainted:
+                            tainted.add(tgt.id)
+                        else:
+                            tainted.discard(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        for el in tgt.elts:
+                            if isinstance(el, ast.Name):
+                                local.add(el.id)
+                                if rhs_tainted:
+                                    tainted.add(el.id)
+                    elif isinstance(tgt, ast.Subscript):
+                        base = tgt.value
+                        if isinstance(base, ast.Name) and base.id not in local:
+                            self.flag(
+                                st, "impure-closure",
+                                f"item-assignment to closure name `{base.id}`"
+                                f" inside traced body of {self.fname}",
+                            )
+                self._scan_exprs(st.value, tainted, local)
+                continue
+            if isinstance(st, ast.AugAssign):
+                if isinstance(st.target, ast.Name):
+                    local.add(st.target.id)
+                    if _tainted_uses(st.value, tainted):
+                        tainted.add(st.target.id)
+                self._scan_exprs(st.value, tainted, local)
+                continue
+            # everything else: scan contained expressions
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._scan_exprs(child, tainted, local)
+                elif isinstance(child, ast.stmt):
+                    self._walk([child], tainted, local)
+
+    # -- expression-level rules --------------------------------------------
+
+    def _scan_exprs(self, expr, tainted: set[str], local: set[str]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                inner = _JitBodyLint(
+                    f"{self.fname}.<lambda>", self.findings, self.path, self.lines
+                )
+                inner_t = set(tainted) | {a.arg for a in node.args.args}
+                inner._walk([ast.Expr(node.body)], inner_t, set(local))
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            dotted = _dotted(fn)
+            # host-sync: .item() / .tolist()
+            if isinstance(fn, ast.Attribute) and fn.attr in ("item", "tolist"):
+                self.flag(
+                    node, "host-sync",
+                    f".{fn.attr}() device sync inside traced body of {self.fname}",
+                )
+            # host-sync: float()/int()/bool() on a traced value
+            elif (
+                isinstance(fn, ast.Name)
+                and fn.id in _HOST_CAST_FNS
+                and any(_tainted_uses(a, tainted) for a in node.args)
+            ):
+                self.flag(
+                    node, "host-sync",
+                    f"{fn.id}() on a traced value in {self.fname}"
+                    " (concretizes the tracer)",
+                )
+            # host-sync: np.asarray / np.array of a traced value
+            elif (
+                dotted is not None
+                and dotted.split(".")[0] in _NP_NAMES
+                and dotted.split(".")[-1] in ("asarray", "array")
+                and any(_tainted_uses(a, tainted) for a in node.args)
+            ):
+                self.flag(
+                    node, "host-sync",
+                    f"{dotted}() of a traced value in {self.fname}",
+                )
+            # impure-closure: impure host calls
+            elif dotted is not None and (
+                tuple(dotted.split(".")[:2]) in _IMPURE_CALLS
+                or dotted.split(".")[0] in _IMPURE_MODULES
+                or dotted.startswith("os.environ")
+                or dotted == "open"
+            ):
+                self.flag(
+                    node, "impure-closure",
+                    f"impure call {dotted}() inside traced body of {self.fname}"
+                    " (runs ONCE at trace time)",
+                )
+            # impure-closure: mutating a closure name
+            elif (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _MUTATORS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id not in local
+            ):
+                self.flag(
+                    node, "impure-closure",
+                    f"`{fn.value.id}.{fn.attr}()` mutates closure state inside"
+                    f" traced body of {self.fname}",
+                )
+
+
+def _lint_static_calls(tree, scan: _ModuleScan, path, lines, findings) -> None:
+    """static-unhashable: calls passing unhashable literals for declared
+    static argnums/argnames (same-module resolution)."""
+
+    def unhashable(node) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+            return True
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            return d is not None and (
+                d.split(".")[-1] in ("array", "asarray")
+                and d.split(".")[0] in _NP_NAMES
+                or d in ("list", "dict", "set", "bytearray")
+            )
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+            continue
+        spec = scan.static_specs.get(node.func.id)
+        if spec is None:
+            continue
+        nums, names = spec
+        bad = [
+            a for i, a in enumerate(node.args) if i in nums and unhashable(a)
+        ] + [
+            kw.value for kw in node.keywords
+            if kw.arg in names and unhashable(kw.value)
+        ]
+        for a in bad:
+            line = a.lineno
+            findings.append(
+                Finding(
+                    path, line, "static-unhashable",
+                    f"unhashable literal passed for a static arg of"
+                    f" {node.func.id}() (declares static_argnums={nums},"
+                    f" static_argnames={names})",
+                    lines[line - 1].strip() if line <= len(lines) else "",
+                )
+            )
+
+
+def lint_file(path: str, rel: str | None = None) -> list[Finding]:
+    with open(path) as f:
+        src = f.read()
+    rel = rel or path
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 1, "host-sync", f"unparseable: {e}", "")]
+    scan = _ModuleScan()
+    scan.visit(tree)
+    scan.resolve_aliases()
+    findings: list[Finding] = []
+
+    # jit scopes by name / decorator
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in scan.traced:
+                _JitBodyLint(node.name, findings, rel, lines).run(
+                    node,
+                    scan.traced[node.name],
+                    scan.static_specs.get(node.name, ((), ())),
+                )
+        elif isinstance(node, ast.Lambda) and node in scan.traced_lambdas:
+            _JitBodyLint("<lambda>", findings, rel, lines).run(node, 0)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _lint_static_calls(tree, scan, rel, lines, findings)
+
+    # pragma suppression: the flagged line or the one above
+    kept = []
+    for f in findings:
+        allowed = set()
+        for ln in (f.line, f.line - 1):
+            if 1 <= ln <= len(lines):
+                m = _PRAGMA_RE.search(lines[ln - 1])
+                if m:
+                    allowed.update(
+                        p.strip() for p in m.group(1).split(",")
+                    )
+        if f.rule in allowed or "all" in allowed:
+            continue
+        kept.append(f)
+    # dedupe identical findings on one line (nested walks may revisit)
+    seen, out = set(), []
+    for f in kept:
+        k = (f.path, f.line, f.rule, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+_BASELINE_PATH = os.path.join(os.path.dirname(__file__), "hygiene_baseline.json")
+
+
+def load_baseline(path: str | None = None) -> set[tuple]:
+    path = path or _BASELINE_PATH
+    try:
+        with open(path) as f:
+            entries = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    return {(e["path"], e["rule"], e["context"]) for e in entries}
+
+
+def lint_tree(
+    root: str | None = None, baseline: set | None = None
+) -> tuple[list[Finding], int]:
+    """Lint every .py under ``root`` (default: the lighthouse_tpu package).
+    Returns (findings not in the baseline, count suppressed by baseline)."""
+    root = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline = load_baseline() if baseline is None else baseline
+    findings: list[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, os.path.dirname(root))
+            findings.extend(lint_file(full, rel))
+    kept = [f for f in findings if f.key() not in baseline]
+    return kept, len(findings) - len(kept)

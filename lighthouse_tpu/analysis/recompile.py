@@ -1,0 +1,122 @@
+"""Pass 3 — the recompilation sentinel: prove steady-state loops stay warm.
+
+A steady-state serving loop (the firehose verify pipeline, the epoch-engine
+sweep, a bench rung) must trigger ZERO XLA compilations after warm-up —
+one stray recompile per step is exactly the hazard that burns a scarce TPU
+window on compiling instead of measuring. JAX has no public "compiles so
+far" counter, but ``jax_log_compiles`` emits one log record per actual
+backend compilation ("Compiling <name> with global shapes and types ...");
+the sentinel captures those records on the ``jax`` logger and exposes them
+as a monotonic per-kernel count.
+
+Usage::
+
+    with CompilationSentinel() as sentinel:
+        warmup()
+        mark = sentinel.snapshot()
+        for _ in range(steps):
+            steady_step()
+        assert sentinel.compiles_since(mark) == []   # names of new compiles
+
+or the one-shot helper ``steady_state_compiles(step_fn, warmup=2, steps=3)``.
+
+The capture is process-wide (XLA compilation is process-wide state);
+sentinels do not nest meaningfully and tests serialize on one.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+
+__all__ = ["CompilationSentinel", "steady_state_compiles"]
+
+_COMPILE_RE = re.compile(r"^Compiling ([^\s]+) with global shapes")
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.events: list[str] = []
+        self._lock2 = threading.Lock()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILE_RE.match(record.getMessage())
+        except Exception:  # noqa: BLE001 — a log formatting error is not ours
+            return
+        if m:
+            with self._lock2:
+                self.events.append(m.group(1))
+
+
+class CompilationSentinel:
+    """Context manager counting XLA compilations while active."""
+
+    def __init__(self):
+        self._handler = _CaptureHandler()
+        self._logger = logging.getLogger("jax")
+        self._prev_flag = None
+        self._prev_level = None
+        self._prev_propagate = None
+
+    def __enter__(self) -> "CompilationSentinel":
+        import jax
+
+        self._prev_flag = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        # jax_log_compiles promotes the records to WARNING; make sure the
+        # logger does not filter them out regardless of ambient config
+        self._prev_level = self._logger.level
+        if self._logger.getEffectiveLevel() > logging.WARNING:
+            self._logger.setLevel(logging.WARNING)
+        # our handler on 'jax' still sees every child record; stopping
+        # propagation there keeps the per-compile WARNINGs off the root
+        # handlers (stderr) while the sentinel is active
+        self._prev_propagate = self._logger.propagate
+        self._logger.propagate = False
+        self._logger.addHandler(self._handler)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        self._logger.removeHandler(self._handler)
+        self._logger.setLevel(self._prev_level)
+        self._logger.propagate = self._prev_propagate
+        jax.config.update("jax_log_compiles", self._prev_flag)
+
+    # -- counters ----------------------------------------------------------
+
+    @property
+    def events(self) -> list[str]:
+        """Kernel names, one per compilation, in order."""
+        with self._handler._lock2:
+            return list(self._handler.events)
+
+    @property
+    def total(self) -> int:
+        return len(self._handler.events)
+
+    def snapshot(self) -> int:
+        """Mark the current compile count (call after warm-up)."""
+        return self.total
+
+    def compiles_since(self, mark: int) -> list[str]:
+        """Names of kernels compiled since ``snapshot()`` — empty means the
+        loop is steady-state clean."""
+        return self.events[mark:]
+
+
+def steady_state_compiles(step_fn, warmup: int = 2, steps: int = 3) -> list[str]:
+    """Run ``step_fn()`` ``warmup`` times, then ``steps`` more under the
+    sentinel; return the names of kernels compiled during the steady phase
+    (empty = zero recompiles after warm-up)."""
+    with CompilationSentinel() as sentinel:
+        for _ in range(warmup):
+            step_fn()
+        mark = sentinel.snapshot()
+        for _ in range(steps):
+            step_fn()
+        return sentinel.compiles_since(mark)

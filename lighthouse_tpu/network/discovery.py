@@ -282,6 +282,14 @@ class DiscoveryService:
         # (discv5); candidates arriving while a check is in flight drop.
         self._pending_evictions: dict[int, tuple[bytes, ENR, float]] = {}
         self._pending_lock = threading.Lock()
+        # per-request FINDNODE response tracking: responder node_id ->
+        # events set by the serve loop when that peer's NODES response
+        # lands (a list — concurrent lookups may query the same peer, and
+        # one response settles every waiter). Replaces the old table-size
+        # polling, which burned the full timeout whenever a response taught
+        # nothing new (already-known records).
+        self._pending_requests: dict[bytes, list[threading.Event]] = {}
+        self._requests_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -428,19 +436,32 @@ class DiscoveryService:
                 break
         return self.table.closest(target, K_BUCKET)
 
-    def _find_node(self, enr: ENR, distances: list[int], timeout: float) -> None:
+    def _find_node(self, enr: ENR, distances: list[int], timeout: float) -> bool:
+        """Send FINDNODE and wait for THIS peer's NODES response (per-request
+        tracking — the serve loop signals the event when the response
+        arrives, whether or not it taught any new record). Returns True when
+        the peer answered within the timeout."""
         body = bytes([len(distances)]) + b"".join(
             struct.pack(">H", d) for d in distances
         )
-        self._send(enr.udp_addr, _FINDNODE, body)
-        # responses are handled asynchronously by the serve loop; give it a
-        # beat to land (lookup rounds tolerate missing answers)
-        deadline = time.monotonic() + timeout
-        before = len(self.table)
-        while time.monotonic() < deadline:
-            time.sleep(0.02)
-            if len(self.table) > before:
-                return
+        ev = threading.Event()
+        with self._requests_lock:
+            self._pending_requests.setdefault(enr.node_id, []).append(ev)
+        try:
+            self._send(enr.udp_addr, _FINDNODE, body)
+            return ev.wait(timeout)
+        finally:
+            with self._requests_lock:
+                evs = self._pending_requests.get(enr.node_id)
+                if evs is not None:
+                    # remove only THIS call's event — a concurrent request
+                    # to the same peer must keep its own waiter registered
+                    try:
+                        evs.remove(ev)
+                    except ValueError:
+                        pass
+                    if not evs:
+                        del self._pending_requests[enr.node_id]
 
     # -- wire --------------------------------------------------------------
 
@@ -478,6 +499,13 @@ class DiscoveryService:
                 self._answer_findnode(src, body)
             elif kind == _NODES:
                 self._ingest_nodes(body)
+                # settle every outstanding FINDNODE to this responder
+                # (after ingest, so the waiters observe the admitted
+                # records)
+                with self._requests_lock:
+                    evs = list(self._pending_requests.get(sender.node_id, ()))
+                for ev in evs:
+                    ev.set()
             # PONG: the ENR admission above is the whole effect
 
     def _answer_findnode(self, src: tuple, body: bytes) -> None:

@@ -10,8 +10,8 @@ stack is built on:
     bits and values beyond p; that's fine. The operand budget (enforced statically by
     plans.lincomb) is: values < 1200p and limbs < 2^22. The schoolbook convolution is
     exact for limbs up to 2^22 (25 * 2^44 < 2^50 per uint64 accumulator). Convention:
-    values crossing a public tower-op boundary satisfy plans.PUB_BOUND (16-bit limbs,
-    value < 16p); lazy values live only between two multiplies. ``sub(a, b)``/``neg``
+    values crossing a public tower-op boundary satisfy plans.PUB_BOUND (17-bit limbs,
+    value < 16p, top limb <= 2); lazy values live only between two multiplies. ``sub(a, b)``/``neg``
     here require a public-bounded subtrahend (any multiply output): they add a
     borrow-inflated multiple of p whose limbs dominate the public bound. The
     tower layer (plans/tower) uses bound-tracked inflated constants instead.
@@ -28,8 +28,12 @@ stack is built on:
     so serialization and hashing skip domain conversion entirely.
 
 ``mont_mul`` (name kept for call-site compatibility) returns a *public-bounded*
-value: < 13p, 16-bit limbs, top limb <= 2 (plans.PUB_BOUND). Equality, parity and
-serialization go through ``canonical()`` which finishes the reduction to < p.
+value: <= 13p (PUB_VALUE_LIMIT), 17-bit limbs (PUB_LIMB_TARGET), top limb <= 2
+— inside plans.PUB_BOUND. Equality, parity and serialization go through
+``canonical()`` which finishes the reduction to < p. Every bound claim in this
+module is machine-checked: the limb-bound certifier (``analysis/bounds.py``,
+``python -m lighthouse_tpu.analysis --bounds``) re-executes the op graphs
+abstractly and proves each obligation per backend (BOUNDS_CERT.json).
 
 Correctness is pinned against ``lighthouse_tpu.ops.bls_oracle`` on random inputs.
 This layer is the TPU twin of the blst field backend the reference links against
@@ -52,6 +56,29 @@ LIMB_BITS = 16
 MASK = np.uint64(0xFFFF)
 
 R_MONT = 1  # plain-residue domain (no Montgomery factor; see module docstring)
+
+# --------------------------------------------------------------------------------------
+# Certification sink (analysis/bounds.py)
+#
+# Every bound this module proves statically at trace time — conv-accumulator
+# exactness, fold-accumulator wrap safety, reduction-walk targets — is both
+# asserted (as before) and, when a sink is installed, RECORDED as a proof
+# obligation (kind, proven bound, declared limit). The limb-bound certifier
+# re-executes the op graphs abstractly (jax.eval_shape) with the sink
+# installed and emits BOUNDS_CERT.json from the records; production traces
+# pay one `is None` check per obligation.
+# --------------------------------------------------------------------------------------
+
+_CERT_SINK = None
+
+
+def _cert(kind: str, proven: int, limit: int, note: str = "") -> bool:
+    """Record (and return) the obligation ``proven <= limit``. With no sink
+    installed this is just the comparison the surrounding assert uses."""
+    ok = proven <= limit
+    if _CERT_SINK is not None:
+        _CERT_SINK.record(kind, proven, limit, note=note, ok=ok)
+    return ok
 
 
 def int_to_limbs(x: int) -> np.ndarray:
@@ -372,20 +399,35 @@ def conv_limb_bounds(in_limb_a: int, in_limb_b: int | None = None) -> list[int]:
             (min(d, 2 * _N_DIGITS - 2 - d, _N_DIGITS - 1) + 1) * da * db
             for d in range(2 * _N_DIGITS - 1)
         ] + [0]
-        assert max(per_digit) < 1 << 24, "digit conv exceeds f32 exactness"
+        assert _cert(
+            "conv_digit_f32_exact", max(per_digit), (1 << 24) - 1
+        ), "digit conv exceeds f32 exactness"
+        # the u32 cast of the digit accumulators is lossless iff they are
+        # f32-exact (< 2^24 < 2^32) — same obligation, recorded explicitly
+        _cert("conv_digit_u32_nowrap", max(per_digit), (1 << 32) - 1)
         limb_b = [
             per_digit[2 * s] + (per_digit[2 * s + 1] << 8)
             for s in range(_N_DIGITS)
         ]
         # limb 50 is folded into limb 49 by _conv_product_digits
         limb_b[2 * NLIMBS - 1] += limb_b[2 * NLIMBS] << LIMB_BITS
+        assert _cert(
+            "conv_digit_u64_acc", max(limb_b), (1 << 64) - 1
+        ), "digit conv u64 recombination overflow"
         return limb_b[: 2 * NLIMBS]
     bounds = [
         max(1, min(i + 1, NLIMBS, 2 * NLIMBS - 1 - i)) * in_limb_a * in_limb_b
         for i in range(2 * NLIMBS)
     ]
     if conv_backend() == "f64":
-        assert max(bounds) < 1 << 53, "f64 conv exceeds f64 exactness"
+        assert _cert(
+            "conv_f64_exact", max(bounds), (1 << 53) - 1
+        ), "f64 conv exceeds f64 exactness"
+    else:
+        # shear path: plain u64 accumulators must not wrap
+        assert _cert(
+            "conv_u64_acc", max(bounds), (1 << 64) - 1
+        ), "shear conv u64 accumulator overflow"
     return bounds
 
 
@@ -524,7 +566,9 @@ def _fold_high(t, s: _RState):
         b + sum(hb * int(_FOLD_NP[j, i]) for j, hb in enumerate(hi_b))
         for i, b in enumerate(lo_b)
     ]
-    assert max(limbs) < _cap_of(t), "fold accumulator overflow"
+    assert _cert(
+        "fold_acc_nowrap", max(limbs), _cap_of(t) - 1
+    ), "fold accumulator overflow"
     lo_val = sum(b << (LIMB_BITS * i) for i, b in enumerate(lo_b))
     value = min(s.value, lo_val) + sum(
         hb * _FOLD_VALS[j] for j, hb in enumerate(hi_b)
@@ -563,7 +607,9 @@ def _fold_384(t, s: _RState):
     limbs = [
         b + top_b * int(_RT384_NP[i]) for i, b in enumerate(s.limbs[:24])
     ] + [top_b * int(_RT384_NP[24])]
-    assert max(limbs) < _cap_of(t), "fold384 accumulator overflow"
+    assert _cert(
+        "fold384_acc_nowrap", max(limbs), _cap_of(t) - 1
+    ), "fold384 accumulator overflow"
     lo_val = sum(b << (LIMB_BITS * i) for i, b in enumerate(s.limbs[:24]))
     return t, _RState(limbs, min(s.value, lo_val) + top_b * _RT384_VAL)
 
@@ -577,7 +623,9 @@ def _propagate_approx(t, s: _RState, n_out: int, target: int = PUB_LIMB_TARGET):
     Value-invariant, elementwise, no scan — exactness is only needed at
     comparison/serialization sites (fq.canonical), not inside the multiply
     pipeline, whose public contract tolerates 17-bit limbs."""
-    assert s.value < 1 << (LIMB_BITS * n_out), "carry walk would drop value"
+    assert _cert(
+        "carry_walk_width", s.value, (1 << (LIMB_BITS * n_out)) - 1
+    ), "carry walk would drop value"
     if t.shape[-1] < n_out:
         t = jnp.pad(t, [(0, 0)] * (t.ndim - 1) + [(0, n_out - t.shape[-1])])
     limbs = list(s.limbs) + [0] * (n_out - len(s.limbs))
@@ -675,10 +723,14 @@ def reduce_limbs(
     # top <= 2 since value < 13p and limbs are non-negative:
     # limb24 <= value >> 384)
     t, s = _propagate_approx(t, s, NLIMBS, limb_target)
-    assert s.value <= value_limit
-    assert max(s.limbs) <= limb_target
+    assert _cert("reduce_value", s.value, value_limit)
+    assert _cert("reduce_limb", max(s.limbs), limb_target)
     if value_limit == PUB_VALUE_LIMIT:
-        assert min(s.limbs[24], s.value >> (LIMB_BITS * 24)) <= 2
+        assert _cert(
+            "reduce_top_limb",
+            min(s.limbs[24], s.value >> (LIMB_BITS * 24)),
+            2,
+        )
     if _is_f64(t):
         # materialization fence + exact cast (limbs <= limb_target < 2^53):
         # without the barrier XLA CPU duplicates the whole elementwise walk
@@ -702,7 +754,7 @@ def _conv_limb_bounds(lb: int):
 def mont_mul(a, b):
     """Product a*b mod p (plain domain — the historical name is kept for the
     call sites). Operands may be lazy up to _IN_VALUE (1200p) with limbs up to
-    _IN_LIMB (2^22); output satisfies plans.PUB_BOUND (< 13p, 16-bit limbs,
+    _IN_LIMB (2^22); output satisfies plans.PUB_BOUND (<= 13p, 17-bit limbs,
     top <= 2).
 
     The conv runs in f64 (CPU) / f32 digits (TPU). On the f64 backend the
@@ -719,12 +771,34 @@ def mont_sqr(a):
 
 
 # Lazy chain target (see reduce_limbs): interior values of fixed-exponent /
-# fixed-scalar chains. 20-bit limbs and value < 64p re-enter the convolution
-# budget directly (f64: 25 * 2^40 < 2^53; digits: per-digit < 2^24), so chain
-# steps skip the tail of the reduction walk. Must stay in sync with
-# plans.CHAIN_BOUND.
+# fixed-scalar chains run at this bound and only the chain's final result
+# pays the full normalization walk. THE derivation (single source of truth —
+# plans.CHAIN_BOUND and every docstring bound derive from these names):
+#
+#   CHAIN_LIMB_TARGET = 2^20 - 1, CHAIN_VALUE_P = 64 (value < 64p) because a
+#   chain step's output must re-enter the next convolution directly, i.e.
+#   sit inside the lazy conv budget (_IN_LIMB = 2^22 - 1, _IN_VALUE = 1200p)
+#   AND keep the conv accumulators exact on every backend:
+#     f64:    25 * (2^20)^2         = 25 * 2^40   < 2^53   (f64 exactness)
+#     digits: 51 * (255 + 2^4)^2    ~  2^21.8     < 2^24   (f32 exactness)
+#   (both re-checked per trace by conv_limb_bounds and certified by
+#   analysis/bounds.py). The top-limb bound is not independent: limbs are
+#   non-negative, so limb 24 <= value >> 384 — chain_top_limb() below.
+CHAIN_VALUE_P = 64
 CHAIN_LIMB_TARGET = (1 << 20) - 1
-CHAIN_VALUE_LIMIT = 64 * P
+CHAIN_VALUE_LIMIT = CHAIN_VALUE_P * P
+
+
+def chain_top_limb() -> int:
+    """Provable limb-24 bound of a chain-interior value: min(limb bound,
+    value >> 384) — for 64p that is 6 (tightens the former hand-written 7,
+    which over-declared what the reduction walk guarantees)."""
+    return min(CHAIN_LIMB_TARGET, CHAIN_VALUE_LIMIT >> (LIMB_BITS * 24))
+
+
+# the chain fixed point must sit inside the conv-input budget, or interior
+# outputs could not feed the next multiply without renormalization
+assert CHAIN_LIMB_TARGET <= _IN_LIMB and CHAIN_VALUE_LIMIT <= _IN_VALUE
 
 
 def mont_mul_lazy(a, b):
@@ -733,6 +807,8 @@ def mont_mul_lazy(a, b):
     same bound — a fixed point, so chains of any length stay in budget.
     Shorter reduction walk than mont_mul (bound-precise conv inputs AND a
     lazier target)."""
+    _cert("chain_in_budget_limb", CHAIN_LIMB_TARGET, _IN_LIMB)
+    _cert("chain_in_budget_value", CHAIN_VALUE_LIMIT, _IN_VALUE)
     t = _conv_product_keep(a, b)
     return reduce_limbs(
         t,
@@ -819,7 +895,7 @@ def sqrt_candidate(a):
 
 
 def sgn0(a):
-    """RFC 9380 sgn0 (parity) of a Montgomery-form element."""
+    """RFC 9380 sgn0 (parity) of a lazy plain-residue element."""
     return from_mont(a)[..., 0] & jnp.uint64(1)
 
 
@@ -837,6 +913,6 @@ def lex_gt_half_canon(canon):
 
 
 def lex_gt_half(a):
-    """y > (p-1)/2 on a Montgomery-form element — the compressed-point sign bit
+    """y > (p-1)/2 on a lazy plain-residue element — the compressed-point sign bit
     (ZCash serialization convention used by the reference's pubkey/sig bytes)."""
     return lex_gt_half_canon(from_mont(a))

@@ -47,12 +47,15 @@ from ..bls_oracle.fields import P
 # --------------------------------------------------------------------------------------
 
 PUB_VALUE_P = 16          # public elements have value < 16 p
-PUB_LIMB = (1 << 17) - 1  # ... and 17-bit limbs (limbs 0..23); exact 16-bit
-                          # normalization happens only at comparison sites
+PUB_LIMB = fq.PUB_LIMB_TARGET  # ... and 17-bit limbs (limbs 0..23); exact
+                          # 16-bit normalization only at comparison sites
 PUB_TOP_LIMB = 2          # ... limb 24 <= 2 (value < 16p refines it)
 
-MAX_VALUE_P = 1200        # lazy operand budget (must match fq._IN_VALUE)
-MAX_LIMB = 1 << 22
+# Lazy operand budget — the SAME constants fq.py's conv pipeline assumes
+# (fq._IN_VALUE / fq._IN_LIMB); single source of truth in fq.py.
+MAX_VALUE_P = 1200
+assert MAX_VALUE_P * P == fq._IN_VALUE
+MAX_LIMB = fq._IN_LIMB + 1  # strict bound: limbs < 2^22
 
 
 class LC:
@@ -140,7 +143,7 @@ class Plan:
     def __init__(self, n_a: int, n_b: int, consts=None):
         self.n_a = n_a
         self.n_b = n_b
-        self.consts = consts or []  # list of Python ints (Montgomery residues)
+        self.consts = consts or []  # list of Python ints (plain residues)
         self.a_rows: list[LC] = []
         self.b_rows: list[LC] = []
         self.out_rows: list[LC] = []
@@ -272,13 +275,16 @@ def sub_bound(minuend: "_Bound", subtrahend: "_Bound") -> "_Bound":
 
 PUB_BOUND = _Bound(PUB_VALUE_P, PUB_LIMB, PUB_TOP_LIMB)
 CANON_BOUND = _Bound(1, (1 << 16) - 1, 0)  # canonical values are exact 16-bit
-# Lazy chain-interior bound (fq.CHAIN_LIMB_TARGET / fq.CHAIN_VALUE_LIMIT):
-# 20-bit limbs, value < 64p, top limb <= 64p >> 384 = 7. A fixed point of
-# chain steps — outputs at this bound feed the next step's lincombs within
-# the lazy budget, skipping the tail of the reduction walk (see
+# Lazy chain-interior bound, DERIVED from fq.py's named constants (the
+# derivation — why 20-bit limbs / 64p re-enter the conv budget on every
+# backend — lives in one place, next to fq.CHAIN_LIMB_TARGET). A fixed
+# point of chain steps: outputs at this bound feed the next step's lincombs
+# within the lazy budget, skipping the tail of the reduction walk (see
 # fq.reduce_limbs). PUB_BOUND inputs are below it, so chains start from
 # public values without renormalization.
-CHAIN_BOUND = _Bound(64, (1 << 20) - 1, 7)
+CHAIN_BOUND = _Bound(
+    fq.CHAIN_VALUE_P, fq.CHAIN_LIMB_TARGET, fq.chain_top_limb()
+)
 
 
 def _lincomb_bounds(rows: list[LC], bound_for, name: str):
@@ -308,8 +314,12 @@ def _lincomb_bounds(rows: list[LC], bound_for, name: str):
             value_p += K
             limb += int(max(subc[:24]))
             top += int(subc[24])
-        assert value_p < MAX_VALUE_P, f"{name}: value bound {value_p}p exceeds budget"
-        assert limb < MAX_LIMB, f"{name}: limb bound {limb} exceeds 2^22"
+        assert fq._cert(
+            "lincomb_value_budget", value_p, MAX_VALUE_P - 1, note=name
+        ), f"{name}: value bound {value_p}p exceeds budget"
+        assert fq._cert(
+            "lincomb_limb_budget", limb, MAX_LIMB - 1, note=name
+        ), f"{name}: limb bound {limb} exceeds 2^22"
         worst.value_p = max(worst.value_p, value_p)
         worst.limb = max(worst.limb, limb)
         worst.top = max(worst.top, top)
@@ -385,7 +395,12 @@ def _verify_carry_norm_schedule(n_folds: int) -> None:
         limbs = [min(b, value >> (16 * i)) for i, b in enumerate(limbs)]
         # fold the 2^384 excess: new value <= (value below 2^384) + top * rt_val
         top = limbs[24]
-        assert top * max(rt) + max(limbs[:24]) < 1 << 64
+        assert fq._cert(
+            "carry_norm_fold_nowrap",
+            top * max(rt) + max(limbs[:24]),
+            (1 << 64) - 1,
+            note="carry_norm",
+        )
         lo_val = sum(b << (16 * i) for i, b in enumerate(limbs[:24]))
         value = min(lo_val, value) + top * rt_val
         limbs = [b + top * rt[i] for i, b in enumerate(limbs[:24])] + [
@@ -396,9 +411,15 @@ def _verify_carry_norm_schedule(n_folds: int) -> None:
     carried = [0] + [b >> 16 for b in limbs[:-1]]
     limbs = [min(b, 0xFFFF) + c for b, c in zip(limbs, carried)]
     limbs = [min(b, value >> (16 * i)) for i, b in enumerate(limbs)]
-    assert value < PUB_VALUE_P * P, f"carry_norm value bound {value / P}p"
-    assert max(limbs) <= PUB_LIMB, f"carry_norm limb bound {max(limbs):#x}"
-    assert limbs[24] <= PUB_TOP_LIMB
+    assert fq._cert(
+        "carry_norm_value", value, PUB_VALUE_P * P - 1, note="carry_norm"
+    ), f"carry_norm value bound {value / P}p"
+    assert fq._cert(
+        "carry_norm_limb", max(limbs), PUB_LIMB, note="carry_norm"
+    ), f"carry_norm limb bound {max(limbs):#x}"
+    assert fq._cert(
+        "carry_norm_top_limb", limbs[24], PUB_TOP_LIMB, note="carry_norm"
+    )
 
 
 _CARRY_NORM_FOLDS = 3
@@ -474,7 +495,9 @@ def execute(
     T = fq._conv_product_keep(A, B)  # [..., L, 50] unreduced accumulators
     conv_limb = max(fq.conv_limb_bounds(ba.limb, bb.limb))
     cap = fq._cap_of(T)
-    assert conv_limb < 1 << 63, f"{name}: conv accumulator overflow"
+    assert fq._cert(
+        "execute_conv_acc", conv_limb, (1 << 63) - 1, note=name
+    ), f"{name}: conv accumulator overflow"
     # a carry round caps limbs (~2^33) so out-row accumulation and
     # subtraction covers stay inside the dtype cap (f64: 2^53) — SKIPPED
     # when the raw conv bounds already fit (common for lazy chain interiors,
@@ -521,7 +544,9 @@ def execute(
             subc = _subc_wide(n_wide, n_limb)
             consts[r] = subc
             limb += int(subc.max())
-        assert limb < cap, f"{name}: wide accumulator bound 2^{limb.bit_length()}"
+        assert fq._cert(
+            "execute_wide_acc", limb, cap - 1, note=name
+        ), f"{name}: wide accumulator bound 2^{limb.bit_length()}"
         worst_limb = max(worst_limb, limb)
     m_pos, m_neg = _lincomb_matrices(out_rows, T.shape[-2])
     out = _apply_matrices(m_pos, m_neg, consts, T)
@@ -529,8 +554,11 @@ def execute(
     if out_bound is None:
         return fq.reduce_limbs(out, [worst_limb] * n_wide, value_bound)
     # the declared top-limb bound must dominate what the walk guarantees
-    assert out_bound.top >= min(
-        out_bound.limb, (out_bound.value_p * P) >> (16 * 24)
+    assert fq._cert(
+        "out_bound_top_sound",
+        min(out_bound.limb, (out_bound.value_p * P) >> (16 * 24)),
+        out_bound.top,
+        note=name,
     ), "out_bound.top unsound for its value/limb bounds"
     return fq.reduce_limbs(
         out,

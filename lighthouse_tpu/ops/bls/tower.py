@@ -1,8 +1,10 @@
 """Fq2 / Fq6 / Fq12 tower arithmetic as JAX kernels (plan-compiled).
 
 Flat element layout (see plans.py): fq2 = [..., 2, 25], fq6 = [..., 6, 25],
-fq12 = [..., 12, 25] of uint64 16-bit limbs, Montgomery form, "public" bounds
-(16-bit limbs, value < 16p — reduced mod p only at comparisons/serialization).
+fq12 = [..., 12, 25] of uint64 limbs, plain residues (no Montgomery domain),
+"public" bounds — plans.PUB_BOUND: 17-bit limbs, value < 16p, top limb <= 2 —
+reduced mod p only at comparisons/serialization. Bound claims here are
+machine-checked by the limb-bound certifier (analysis/bounds.py).
 
 Every multiplication-bearing op runs as lincomb -> one stacked mont_mul -> lincomb
 via a prebuilt plan. Additions are lazy (no carries). Fixed-exponent walks use
@@ -56,7 +58,7 @@ def t_select(cond, a, b):
 
 def t_canon(a):
     """Fully reduce each coefficient mod p (for comparisons / serialization):
-    one stacked Montgomery multiply by R (same op as fq.normalize)."""
+    one stacked congruence-fold reduction walk (same op as fq.normalize)."""
     return fq.normalize(a)
 
 

@@ -1,0 +1,352 @@
+"""The static-analysis subsystem (ISSUE 5): limb-bound certifier,
+trace-hygiene linter, recompilation sentinel.
+
+Three kinds of coverage:
+  * clean-tree runs — the shipped kernels certify and lint clean (this is
+    the tier-1 gate every future kernel PR must pass);
+  * a fixture corpus of known-bad kernels — overflowing lincomb, wrapped
+    accumulator, tracer-dependent branch, per-step recompile — asserting
+    each pass flags its hazard;
+  * seeded mutations — widening a lazy chain interior (the acceptance
+    criterion's "one extra squaring" bound blow-up) must fail certification
+    on each backend's own obligation.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.analysis import bounds, hygiene
+from lighthouse_tpu.analysis.recompile import (
+    CompilationSentinel,
+    steady_state_compiles,
+)
+from lighthouse_tpu.ops.bls import fq, plans, tower
+
+
+def _e2(batch=4):
+    return jax.ShapeDtypeStruct((batch, 2, fq.NLIMBS), jnp.uint64)
+
+
+def _e1(batch=4):
+    return jax.ShapeDtypeStruct((batch, fq.NLIMBS), jnp.uint64)
+
+
+# =============================================================================
+# Pass 1 — limb-bound certifier
+# =============================================================================
+
+
+@pytest.mark.kernel
+class TestCertifier:
+    def test_clean_tree_proves_every_callsite_both_backends(self):
+        """The whole public op-graph surface certifies under BOTH conv
+        backends (acceptance criterion). Batch 32 exercises the f64-walk
+        dispatch regime; the u64-walk regime is covered below."""
+        cert = bounds.certify(backends=("f64", "digits"), batches=(32,))
+        bad = [r for r in cert["obligations"] if not r["ok"]]
+        assert cert["ok"] and not bad, bad[:5]
+        graphs = {r["graph"] for r in cert["obligations"]}
+        for mod in ("fq.", "tower.", "curve.", "h2c.", "pairing."):
+            assert any(mod in g for g in graphs), f"no obligations from {mod}*"
+        for backend in ("f64@", "digits@"):
+            assert any(g.startswith(backend) for g in graphs)
+        kinds = {r["kind"] for r in cert["obligations"]}
+        assert {
+            "conv_f64_exact",          # (a) f64 partial products < 2^53
+            "conv_digit_f32_exact",    # (a) f32 digit products < 2^24
+            "conv_digit_u32_nowrap",   # (b) u32 cast cannot wrap
+            "fold_acc_nowrap",         # (b) fold accumulators cannot wrap
+            "execute_wide_acc",        # (b) out-row accumulators in cap
+            "reduce_value",            # (c) walks land on declared targets
+            "reduce_limb",
+            "out_bound_top_sound",     # (c) declared CHAIN/out_bound sound
+            "lincomb_limb_budget",
+        } <= kinds
+
+    def test_u64_walk_regime_certifies(self):
+        """Below fq.F64_WALK_MIN_ROWS the f64 backend statically dispatches
+        the u64 reduction walk — its own schedule, certified separately."""
+        cert = bounds.certify(
+            backends=("f64",),
+            batches=(1,),
+            graphs=["fq.mont_mul", "fq.canonical", "tower.fq2_mul"],
+        )
+        assert cert["ok"] and cert["n_failed"] == 0
+
+    def test_seeded_mutation_widened_interior_fails(self, monkeypatch):
+        """Widening one lazy interior by one squaring (declared CHAIN bound
+        becomes the square's unreduced bound) must fail certification —
+        the limb budget blows past 2^22."""
+        widened = plans._Bound(
+            plans.CHAIN_BOUND.value_p ** 2,
+            plans.CHAIN_BOUND.limb ** 2,
+            plans.CHAIN_BOUND.top,
+        )
+        monkeypatch.setattr(plans, "CHAIN_BOUND", widened)
+        rows = bounds.certify_callable(tower.fq2_sqr_lazy, (_e2(),), "f64")
+        assert any(not r["ok"] for r in rows)
+
+    def test_seeded_mutation_wider_chain_limb_fails_digits(self, monkeypatch):
+        """A wider chain limb target breaks the digit backend's f32
+        exactness (a different pass obligation than the f64 mutation)."""
+        monkeypatch.setattr(fq, "CHAIN_LIMB_TARGET", (1 << 27) - 1)
+        rows = bounds.certify_callable(
+            lambda a, b: fq.mont_mul_lazy(a, b), (_e1(), _e1()), "digits"
+        )
+        assert any(
+            not r["ok"]
+            and r["kind"] in ("conv_digit_f32_exact", "unproven_bound")
+            for r in rows
+        )
+
+    def test_fixture_overflowing_lincomb_flagged(self):
+        """Known-bad kernel: a lincomb coefficient that pushes the operand
+        limb bound past the lazy conv budget."""
+        p = plans.Plan(2, 2)
+        x, y = plans.vbasis(2), plans.vbasis(2)
+        lane = p.lane(x[0].scale(1 << 21), y[0])
+        p.out_rows = [lane, lane]
+        rows = bounds.certify_callable(
+            lambda a, b: plans.execute(p, a, b, name="bad_lincomb"),
+            (_e2(), _e2()),
+            "f64",
+        )
+        assert any(
+            not r["ok"]
+            and r["kind"] in ("lincomb_limb_budget", "unproven_bound")
+            for r in rows
+        )
+
+    def test_fixture_wrapped_accumulator_flagged(self):
+        """Known-bad kernel: conv inputs wide enough that the u64 (shear)
+        accumulators wrap."""
+        def bad(a, b):
+            t = fq._conv_product(a, b)
+            lb = fq.conv_limb_bounds(1 << 32)  # asserts: 25 * 2^64 wraps
+            return fq.reduce_limbs(t, lb, (1 << 32 * 25) - 1)
+
+        rows = bounds.certify_callable(bad, (_e1(), _e1()), "shear")
+        assert any(
+            not r["ok"] and r["kind"] in ("conv_u64_acc", "unproven_bound")
+            for r in rows
+        )
+
+    def test_chain_bound_is_derived_and_sound(self):
+        """plans.CHAIN_BOUND is derived from fq's named constants — the
+        derivation (not hand-maintained prose) is what keeps them in sync."""
+        assert plans.CHAIN_BOUND.value_p == fq.CHAIN_VALUE_P
+        assert plans.CHAIN_BOUND.limb == fq.CHAIN_LIMB_TARGET
+        assert plans.CHAIN_BOUND.top == fq.chain_top_limb()
+        # the sound top bound: limbs non-negative => limb24 <= value >> 384
+        assert plans.CHAIN_BOUND.top == min(
+            fq.CHAIN_LIMB_TARGET, fq.CHAIN_VALUE_LIMIT >> (16 * 24)
+        )
+
+
+# =============================================================================
+# Pass 2 — trace-hygiene linter
+# =============================================================================
+
+
+_BAD_MODULE = textwrap.dedent(
+    '''
+    import functools
+    import time
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    LOG = []
+
+    @jax.jit
+    def host_syncs(x):
+        v = x.sum()
+        return float(v) + v.item()
+
+    @jax.jit
+    def tracer_branch(x):
+        if x > 0:                     # fixture: branch on a tracer
+            return x
+        return -x
+
+    @jax.jit
+    def impure(x):
+        LOG.append(time.time())
+        return np.asarray(x) + 1
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def windowed(x, n):
+        return x[:n]
+
+    def caller(x):
+        return windowed(x, n=[1, 2])  # fixture: unhashable static
+
+    def scan_user(xs):
+        def body(carry, x):
+            if carry:                 # fixture: branch inside a scan body
+                carry = carry + x
+            return carry, x
+        return jax.lax.scan(body, 0, xs)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def static_ok(flag, x):
+        if flag:                      # static argnum — NOT a finding
+            return x
+        return x * 2
+
+    @jax.jit
+    def shape_ok(x):
+        if x.shape[0] > 4:            # shape read — static, NOT a finding
+            return x
+        return jnp.pad(x, (0, 4 - x.shape[0]))
+
+    @jax.jit
+    def pragma_ok(x):
+        return int(x[0])              # lint: allow(host-sync)
+    '''
+)
+
+
+class TestHygieneLinter:
+    @pytest.fixture()
+    def bad_module(self, tmp_path):
+        p = tmp_path / "bad_kernels.py"
+        p.write_text(_BAD_MODULE)
+        return str(p)
+
+    def test_fixture_corpus_flags_each_rule(self, bad_module):
+        findings = hygiene.lint_file(bad_module, "bad_kernels.py")
+        rules = {f.rule for f in findings}
+        assert rules == {
+            "host-sync", "tracer-branch", "impure-closure",
+            "static-unhashable",
+        }
+        flagged_fns = " ".join(f.message for f in findings)
+        assert "host_syncs" in flagged_fns
+        assert "tracer_branch" in flagged_fns
+        assert "impure" in flagged_fns
+        assert "body" in flagged_fns          # lax.scan body covered
+        # negative space: statics and shape reads are not findings
+        assert "static_ok" not in flagged_fns
+        assert "shape_ok" not in flagged_fns
+        assert "pragma_ok" not in flagged_fns  # pragma suppression
+
+    def test_baseline_suppression(self, bad_module):
+        findings = hygiene.lint_file(bad_module, "bad_kernels.py")
+        baseline = {f.key() for f in findings}
+        left = [f for f in findings if f.key() not in baseline]
+        assert findings and not left
+
+    def test_clean_tree(self):
+        """The shipped lighthouse_tpu tree lints clean (the firehose and
+        epoch-engine hot paths carry zero findings — fixed or pragma'd)."""
+        findings, _ = hygiene.lint_tree()
+        assert not findings, "\n".join(str(f) for f in findings)
+
+
+# =============================================================================
+# Pass 3 — recompilation sentinel
+# =============================================================================
+
+
+@pytest.mark.kernel
+class TestRecompilationSentinel:
+    def test_fixture_per_step_recompile_flagged(self):
+        """Known-bad loop: the batch shape grows every step, forcing a
+        compile per step — the exact hazard the sentinel exists to catch."""
+
+        @jax.jit
+        def kernel(x):
+            return jnp.sum(x * 2)
+
+        n = [8]
+
+        def leaky_step():
+            n[0] += 1  # unbucketed shape: recompiles every step
+            kernel(jnp.ones(n[0])).block_until_ready()
+
+        names = steady_state_compiles(leaky_step, warmup=1, steps=3)
+        assert len(names) >= 3
+        assert any("kernel" in s for s in names)
+
+    def test_steady_jit_loop_is_clean(self):
+        @jax.jit
+        def kernel(x):
+            return jnp.sum(x + 1)
+
+        names = steady_state_compiles(
+            lambda: kernel(jnp.ones(16)).block_until_ready(),
+            warmup=1,
+            steps=4,
+        )
+        assert names == []
+
+    def test_firehose_steady_state_zero_recompiles(self):
+        """The firehose loop — batcher forming, prep, bucketed device
+        dispatch — triggers zero compiles after warm-up. The device stage is
+        a stand-in kernel honoring the same power-of-two bucket contract as
+        the real backend (tpu_backend.bucket); the full BLS stages are
+        sentinel-checked by the bench rungs, where their compile cost
+        belongs."""
+        from lighthouse_tpu.bls import tpu_backend as tb
+        from lighthouse_tpu.firehose import FirehoseConfig, FirehoseEngine
+
+        @jax.jit
+        def device_stage(x):
+            return jnp.sum(x)
+
+        def verify(items):
+            n_pad = tb.bucket(len(items))
+            buf = np.zeros((n_pad, 4))
+            buf[: len(items)] = 1.0
+            return bool(device_stage(jnp.asarray(buf)) >= 0)
+
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([p], None) for p in ps],
+            verify_items_fn=verify,
+            config=FirehoseConfig(max_batch=8),
+            synchronous=True,
+        )
+
+        def step():
+            for i in range(8):
+                assert engine.submit(i)
+            engine.drain()
+
+        names = steady_state_compiles(step, warmup=2, steps=4)
+        assert names == [], names
+
+    def test_epoch_engine_steady_state_zero_recompiles(self):
+        """Successive epoch boundaries through the device epoch engine —
+        same registry bucket — compile once and never again (acceptance
+        criterion: zero steady-state recompiles after warm-up)."""
+        from lighthouse_tpu import epoch_engine
+        from lighthouse_tpu.state_transition.genesis import (
+            interop_genesis_state,
+        )
+        from lighthouse_tpu.types.spec import minimal_spec
+
+        spec = minimal_spec(altair_fork_epoch=0)
+        state = interop_genesis_state(spec, 64)
+        slots = spec.preset.SLOTS_PER_EPOCH
+        state.slot = 5 * slots - 1  # at an epoch boundary
+
+        def step():
+            assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+            state.slot += slots  # next boundary, same shape bucket
+
+        prev = epoch_engine.get_backend()
+        epoch_engine.set_backend("device")
+        try:
+            names = steady_state_compiles(step, warmup=2, steps=3)
+        finally:
+            epoch_engine.set_backend(prev)
+        assert names == [], names

@@ -225,6 +225,57 @@ def test_wrong_fork_digest_rejected():
         d2.stop()
 
 
+def test_findnode_per_request_response_tracking():
+    """A peer's NODES response settles the outstanding FINDNODE even when it
+    teaches nothing new — the old table-size polling burned the full timeout
+    whenever the response held only already-known records (ROADMAP discv5
+    hardening: per-request response tracking)."""
+    fork = b"\x0c\x0c\x0c\x0c"
+    a = DiscoveryService(fork_digest=fork).start()
+    b = DiscoveryService(fork_digest=fork).start()
+    try:
+        a.bootstrap(b.enr)
+        assert _wait_for(lambda: len(a.table) == 1 and len(b.table) == 1)
+        # b's entire table is a itself: the NODES response admits nothing
+        # new at a, so table-size polling would see no growth and wait out
+        # the full timeout — per-request tracking returns on the response
+        d = log_distance(b.enr.node_id, a.enr.node_id)
+        t0 = time.monotonic()
+        answered = a._find_node(b.enr, [d], timeout=6.0)
+        dt = time.monotonic() - t0
+        assert answered, "responder's NODES never settled the request"
+        assert dt < 5.0, f"request waited out the timeout ({dt:.2f}s)"
+        # the outstanding-request slot is cleaned up either way
+        assert b.enr.node_id not in a._pending_requests
+        # concurrent lookups querying the SAME peer: one NODES response
+        # settles every waiter (events are per-call, not per-peer)
+        import threading
+
+        results = []
+        ts = [
+            threading.Thread(
+                target=lambda: results.append(
+                    a._find_node(b.enr, [d], timeout=6.0)
+                )
+            )
+            for _ in range(2)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results == [True, True], results
+        # a silent peer (dead UDP port): no response, False at the deadline
+        dead, _ = _fake_enr_at_distance(a.enr.node_id, 256, fork)
+        t0 = time.monotonic()
+        assert not a._find_node(dead, [256], timeout=0.4)
+        assert time.monotonic() - t0 >= 0.4
+        assert a._pending_requests == {}
+    finally:
+        a.stop()
+        b.stop()
+
+
 # ---------------------------------------------------------------------------
 # Transitive discovery: bootstrap from one node, find a third
 # ---------------------------------------------------------------------------

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -49,6 +50,7 @@ RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
 PROBE_TIMEOUT_S = float(os.environ.get("HUNTER_PROBE_TIMEOUT", "120"))
+PREFLIGHT_TIMEOUT_S = float(os.environ.get("HUNTER_PREFLIGHT_TIMEOUT", "600"))
 
 # bench._LADDER reversed: smallest first — land ANY TPU record, then climb.
 # Timeouts get +50% slack over bench's (a window may open mid-compile).
@@ -102,6 +104,51 @@ def probe() -> str | None:
     else:
         log("probe_failed", note=note)
     return platform
+
+
+# ISSUE 5 preflight: a TPU window must never be spent benching a kernel tree
+# that fails static certification (limb-bound proofs / trace-hygiene lint).
+# Memoized per git HEAD — the daemon outlives commits, so a new HEAD re-runs
+# the analysis; a definitive verdict (clean/dirty) sticks for that HEAD.
+_preflight: dict = {"head": None, "ok": None}
+
+
+def kernels_certified() -> bool:
+    head = bench.git_head()
+    if _preflight["head"] == head and _preflight["ok"] is not None:
+        return _preflight["ok"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")  # never touches the tunnel
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "lighthouse_tpu.analysis", "--json",
+             "--cert-out", "-"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=PREFLIGHT_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        # indeterminate — don't cache, retry at the next healthy window
+        log("preflight_timeout", seconds=round(PREFLIGHT_TIMEOUT_S, 1))
+        return False
+    dt = round(time.perf_counter() - t0, 1)
+    ok = proc.returncode == 0
+    try:
+        rep = json.loads(proc.stdout.strip().splitlines()[-1])
+        summary = {
+            "lint_findings": rep.get("lint", {}).get("n_findings"),
+            "bounds_failed": rep.get("bounds", {}).get("n_failed"),
+            "min_margin_bits": rep.get("bounds", {}).get("min_margin_bits"),
+        }
+    except (ValueError, IndexError):
+        # no parseable report: a clean exit makes no sense, and a nonzero
+        # exit is a CRASH (OOM kill, import error), not a real finding —
+        # either way indeterminate: don't cache, retry at the next window
+        log("preflight_unparseable", seconds=dt, returncode=proc.returncode)
+        return False
+    log("preflight_ok" if ok else "preflight_failed",
+        seconds=dt, head=head, **summary)
+    _preflight.update(head=head, ok=ok)
+    return ok
 
 
 def load_state() -> dict:
@@ -182,6 +229,10 @@ def main() -> None:
                 st["cooldown"] -= 1
                 save_state(st)
                 log("bench_cooldown", remaining=st["cooldown"])
+            elif platform == "tpu" and not kernels_certified():
+                # static certification failed at this HEAD: a window spent
+                # benching an unsound kernel is a window wasted (ISSUE 5)
+                log("window_skipped_uncertified_kernels")
             elif platform == "tpu":
                 # a window is open: climb rungs until one fails or all done
                 while st["next_rung"] < len(RUNGS):
