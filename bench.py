@@ -414,15 +414,15 @@ def _stage_breakdown(cache, idx, msgs, sigs) -> dict:
     py = jnp.concatenate([pky[:, 0, :], tb._MG1_Y[None]], axis=0)
     qx = jnp.concatenate([mxa, sax[None]], axis=0)
     qy = jnp.concatenate([mya, say[None]], axis=0)
-    miller = jax.jit(pairing.miller_loop)
+    # the verify path (multi_pairing_is_one) runs the backend-dispatched
+    # product Miller stage: shared-accumulator walk on the digit backend,
+    # batched independent accumulators + product tree on the f64 CPU path
+    miller = jax.jit(pairing.miller_product)
     stages["miller_loops"] = _time_stage(miller, px, py, qx, qy)
-    fs = miller(px, py, qx, qy)
+    f = miller(px, py, qx, qy)
 
-    @jax.jit
-    def final_exp(fs):
-        return pairing.final_exponentiation(pairing.fq12_prod(fs))
-
-    stages["final_exponentiation"] = _time_stage(final_exp, fs)
+    final_exp = jax.jit(pairing.final_exponentiation)
+    stages["final_exponentiation"] = _time_stage(final_exp, f)
     return {k2: round(v, 2) for k2, v in stages.items()}
 
 
@@ -759,6 +759,97 @@ def _inner_h2c():
     )
 
 
+def _inner_pairing():
+    """Pairing micro-rung: the batched-verification endgame (Miller loops +
+    final exponentiation) in isolation, so the chain-planned pairing work is
+    measurable without a full verify run. Reports pairing_sets_per_s for the
+    fused miller+final-exp pipeline at the gossip batch shape — n sets pair
+    n pubkey/message points plus ONE shared signature point, exactly the
+    verify kernel's (n+1)-pair layout — plus per-stage ms. Parity against
+    the Python oracle is asserted on the WHOLE measured pipeline: the
+    device product of all n+1 pairings (through the dispatched Miller
+    stage AND the planned final exponentiation) must equal the oracle's
+    multi-pairing of the same points — the rung verifies while it
+    measures."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    if fallback:
+        jax.config.update("jax_platforms", "cpu")
+
+    from lighthouse_tpu.ops.bls import fq, pairing, tower as tw
+    from lighthouse_tpu.ops.bls_oracle import curves as oc, fields as of
+
+    op = importlib.import_module("lighthouse_tpu.ops.bls_oracle.pairing")
+
+    n = BATCH
+    iters = int(os.environ.get("BENCH_PAIRING_ITERS", "3"))
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0xBA17)
+
+    ks1 = [1 + int.from_bytes(rng.bytes(32), "big") % (of.R - 1)
+           for _ in range(n + 1)]
+    ks2 = [1 + int.from_bytes(rng.bytes(32), "big") % (of.R - 1)
+           for _ in range(n + 1)]
+    g1_pts = [oc.g1_mul(oc.g1_generator(), k) for k in ks1]
+    g2_pts = [oc.g2_mul(oc.g2_generator(), k) for k in ks2]
+    px = jnp.stack([fq.from_int(p[0]) for p in g1_pts])
+    py = jnp.stack([fq.from_int(p[1]) for p in g1_pts])
+    qx = jnp.stack([tw.from_ints([q[0].c0, q[0].c1]) for q in g2_pts])
+    qy = jnp.stack([tw.from_ints([q[1].c0, q[1].c1]) for q in g2_pts])
+
+    # the verify-path pipeline: the backend-dispatched product Miller stage
+    # (what multi_pairing_is_one runs) + one final exponentiation
+    miller = jax.jit(pairing.miller_product)
+    final = jax.jit(pairing.final_exponentiation)
+    t0 = time.perf_counter()
+    f = miller(px, py, qx, qy)
+    out = final(f)
+    jax.block_until_ready(out)
+    print(
+        f"# pairing warmup (compile) {time.perf_counter() - t0:.0f}s "
+        f"on {platform}",
+        flush=True,
+    )
+    # oracle parity of the WHOLE measured pipeline: the device product of
+    # all n+1 pairings (one shared accumulator + one final exponentiation)
+    # must equal the oracle's multi-pairing of the same points
+    acc = op.miller_loop(g1_pts[0], g2_pts[0])
+    for p, q in zip(g1_pts[1:], g2_pts[1:]):
+        acc = acc * op.miller_loop(p, q)
+    assert tw.fq12_to_oracle(out) == op.final_exponentiation(acc), (
+        "device pairing product diverged from the oracle"
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = final(miller(px, py, qx, qy))
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    stages = {"miller_loops": _time_stage(miller, px, py, qx, qy)}
+    stages["final_exponentiation"] = _time_stage(final, f)
+    print(
+        json.dumps(
+            {
+                "metric": "pairing_sets_per_s",
+                "value": round(n * iters / dt, 2),
+                "unit": "sets/s",
+                "platform": platform,
+                "fallback": fallback,
+                "shape": {"batch": n, "pairs": n + 1},
+                "stages_ms_per_batch": {
+                    k: round(v, 2) for k, v in stages.items()
+                },
+            }
+        )
+    )
+
+
 def _build_epoch_state(spec, n: int, rng):
     """Synthetic mainnet-preset altair state with ``n`` validators for the
     epoch-replay rung (BASELINE config #4). Dummy pubkeys: epoch processing
@@ -949,6 +1040,12 @@ _EPOCH_RUNG_FULL = (0, 0, 1048576, 0, 4050.0, "epoch")
 # short TPU window spends its time measuring.
 _H2C_RUNG_SMALL = (0, 0, 0, 8, 1350.0, "h2c")
 
+# pairing micro-rung (the Miller-loop/final-exp endgame in isolation): only
+# `batch` matters. Like the h2c rung it is a small program that stays
+# compile-warm in .jax_cache, so a short TPU window measures instead of
+# compiling.
+_PAIRING_RUNG_SMALL = (0, 0, 0, 8, 1350.0, "pairing")
+
 
 def git_head() -> str:
     """Current repo HEAD (short), best-effort. Shared with the hunter so
@@ -976,6 +1073,7 @@ def _hunter_record(mode: str = "sets") -> dict | None:
         "firehose": "tpu_firehose_record.json",
         "epoch": "tpu_epoch_record.json",
         "h2c": "tpu_h2c_record.json",
+        "pairing": "tpu_pairing_record.json",
     }.get(mode, "tpu_record.json")
     path = os.path.join(_CACHE_DIR, name)
     try:
@@ -1042,6 +1140,8 @@ def main():
         mode = "epoch"
     elif "--h2c" in sys.argv:
         mode = "h2c"
+    elif "--pairing" in sys.argv:
+        mode = "pairing"
     if "--inner" in sys.argv:
         inner_mode = os.environ.get("BENCH_MODE", mode)
         if inner_mode == "firehose":
@@ -1050,6 +1150,8 @@ def main():
             _inner_epoch()
         elif inner_mode == "h2c":
             _inner_h2c()
+        elif inner_mode == "pairing":
+            _inner_pairing()
         else:
             _inner()
         return
@@ -1092,6 +1194,10 @@ def _main_measure(mode: str) -> None:
             # shedding most of a 50k/s offer is the honest record)
             ladder = [(128, 1, 2048, 16, 1800.0)]
     elif mode == "h2c":
+        ladder = [(0, 0, 0, BATCH, 900.0)]
+        if fallback:
+            ladder = [(0, 0, 0, 8, 900.0)]
+    elif mode == "pairing":
         ladder = [(0, 0, 0, BATCH, 900.0)]
         if fallback:
             ladder = [(0, 0, 0, 8, 900.0)]
@@ -1138,6 +1244,7 @@ def _main_measure(mode: str) -> None:
         "firehose": "firehose_attestations_verified_per_s",
         "epoch": "epoch_validators_per_s",
         "h2c": "h2c_points_per_s",
+        "pairing": "pairing_sets_per_s",
     }.get(mode, "bls_attestation_sets_verified_per_s")
     print(
         json.dumps(
@@ -1146,7 +1253,7 @@ def _main_measure(mode: str) -> None:
                 "value": 0.0,
                 "unit": {
                     "firehose": "att/s", "epoch": "validators/s",
-                    "h2c": "points/s",
+                    "h2c": "points/s", "pairing": "sets/s",
                 }.get(mode, "sets/s"),
                 "vs_baseline": 0.0,
                 "platform": platform,

@@ -16,9 +16,10 @@ one the current process uses. This module does both:
   curve h2c chain_plans pairing) **abstractly** via ``jax.eval_shape`` — no
   compilation, no numerics, just the Python trace that runs the bound
   machinery — once per requested conv backend (``LIGHTHOUSE_CONV_IMPL``
-  semantics) and per batch regime (the f64 backend statically dispatches a
-  different walk above ``fq.F64_WALK_MIN_ROWS`` rows, so both dispatch modes
-  are certified).
+  semantics) and per batch regime (bound propagation is shape-dependent).
+  With ``fq.F64_WALK_MIN_ROWS = 0`` every default regime takes the all-f64
+  walk; the still-invocable u64 walk schedule is certified by the
+  forced-threshold run in ``tests/test_analysis.py``.
 * An ``AssertionError`` raised by the bound machinery during a graph trace
   is NOT a certifier crash: it is recorded as an unproven edge and fails
   the certificate — this is how seeded mutations (e.g. a lazy interior
@@ -187,8 +188,23 @@ def graph_registry(batch: int) -> list[tuple]:
         ("tower.fq12_inv", tower.fq12_inv, (e12,)),
         ("tower.fq12_frobenius1", tower.fq12_frobenius1, (e12,)),
         ("tower.fq12_cyclotomic_sqr", tower.fq12_cyclotomic_sqr, (e12,)),
-        ("tower.fq12_cyclotomic_exp_abs_x",
-         tower.fq12_cyclotomic_exp_abs_x, (e12,)),
+        # both |x|-exponentiation variants, explicitly: the chain-plan scan
+        # (lazy F12_BOUND interiors) and the Karabina compressed route —
+        # the runtime default picks by backend, the certificate covers both
+        ("tower.fq12_cyclotomic_exp_abs_x.chain",
+         lambda a: tower.fq12_cyclotomic_exp_abs_x(a, compressed=False),
+         (e12,)),
+        ("tower.fq12_cyclotomic_exp_abs_x.karabina",
+         lambda a: tower.fq12_cyclotomic_exp_abs_x(a, compressed=True),
+         (e12,)),
+        ("tower.fq12_mul_lazy", tower.fq12_mul_lazy, (e12, e12)),
+        ("tower.fq12_sqr_lazy", tower.fq12_sqr_lazy, (e12,)),
+        ("tower.fq12_cyclotomic_sqr_lazy",
+         tower.fq12_cyclotomic_sqr_lazy, (e12,)),
+        ("tower.fq12_compressed_sqr", tower.fq12_compressed_sqr, (s(8, 25),)),
+        ("tower.fq12_compressed_sqr_lazy",
+         tower.fq12_compressed_sqr_lazy, (s(8, 25),)),
+        ("tower.fq12_decompress", tower.fq12_decompress, (s(8, 25),)),
         ("tower.t_eq12", tower.t_eq, (e12, e12)),
         # curve.py — complete formulas, scalar multiplication (chain_plans)
         ("curve.point_add.g1", g(1, curve.point_add), (p1, p1)),
@@ -204,16 +220,27 @@ def graph_registry(batch: int) -> list[tuple]:
          (p2, sc)),
         # h2c.py — SSWU fraction form, isogeny, cofactor clearing
         ("h2c.map_to_g2", h2c.map_to_g2, (e2, e2)),
-        # pairing.py — Miller loop, sparse fold, final exponentiation
+        # pairing.py — planned Miller loop (doubling/addition step plans,
+        # stacked line scaling, sparse 014/01245 folds), final exponentiation
         ("pairing.mul_by_014", pairing.mul_by_014, (e12, e6)),
+        ("pairing.mul_by_01245", pairing.mul_by_01245, (e12, s(10, 25))),
         ("pairing.miller_loop", pairing.miller_loop, (e1, e1, e2, e2)),
+        # the shared-accumulator batch-verify shape: the leading axis is the
+        # pair axis, folded into ONE accumulator via cross-pair line trees
+        ("pairing.miller_loop_product",
+         pairing.miller_loop_product, (e1, e1, e2, e2)),
         ("pairing.final_exponentiation",
          pairing.final_exponentiation, (e12,)),
+        ("pairing.fq12_prod3",
+         lambda a, b, c: pairing.fq12_prod(jnp.stack([a, b, c])),
+         (e12, e12, e12)),
     ]
 
 
-# Batch regimes: the f64 backend statically dispatches the u64 walk below
-# fq.F64_WALK_MIN_ROWS rows and the all-f64 walk at/above it — certify both.
+# Batch regimes: bound propagation is shape-dependent (broadcast axes reach
+# the lincomb/fold arithmetic), so certify a scalar-ish and a wide regime.
+# NOTE with fq.F64_WALK_MIN_ROWS = 0 both regimes take the all-f64 walk;
+# the u64 walk is covered by the forced-threshold test in test_analysis.py.
 _DEFAULT_BATCHES = (1, 32)
 _DEFAULT_BACKENDS = ("f64", "digits")
 

@@ -446,9 +446,13 @@ def _sharded_miller_stage(mesh, n_pad: int):
     from jax.sharding import PartitionSpec as P
 
     def local(pkx, pky, mxa, mya, valid):
-        fs = pairing.miller_loop(pkx[:, 0, :], pky[:, 0, :], mxa, mya)
-        fs = tower.t_select(valid, fs, tower.one(12, fs.shape[:-2]))
-        return pairing.fq12_prod(fs)[None], jnp.any(valid)[None]
+        # backend-dispatched product Miller stage (PR 6): on the digit
+        # backend one shared fq12 accumulator covers the device's whole
+        # shard; invalid pairs contribute the identity either way
+        f = pairing.miller_product(
+            pkx[:, 0, :], pky[:, 0, :], mxa, mya, valid
+        )
+        return f[None], jnp.any(valid)[None]
 
     return jax.jit(shard_map(
         local, mesh=mesh, in_specs=(P("sets"),) * 5,

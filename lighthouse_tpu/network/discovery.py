@@ -13,12 +13,27 @@ The TPU-native twin of the reference's discovery stack
 * **Routing table** — Kademlia buckets by XOR log-distance over the 32-byte
   node id, k=16 per bucket, LRU within a bucket (discv5 table semantics).
 * **Wire protocol** (UDP datagrams):
-      kind 1 PING     : empty                      (liveness + ENR exchange)
-      kind 2 PONG     : empty
-      kind 3 FINDNODE : u8 n | u16 log-distances   (discv5 FINDNODE)
-      kind 4 NODES    : u16 count | ENR*           (response)
+      kind 1 PING      : empty                      (liveness + ENR exchange)
+      kind 2 PONG      : empty
+      kind 3 FINDNODE  : u8 cookie_len | cookie | u8 n | u16 log-distances
+      kind 4 NODES     : u16 count | ENR*           (response)
+      kind 5 WHOAREYOU : 16-byte cookie             (source-address challenge)
   every packet = u16 enr_len | sender ENR | u8 kind | body — contact alone
   teaches a verified record.
+* **Stateless source-address validation** (discv5 WHOAREYOU): a FINDNODE
+  whose cookie does not validate is answered with a tiny fixed-size
+  WHOAREYOU challenge — BEFORE any ENR signature verification — carrying
+  an HMAC cookie bound to (source ip, port, time window) under a local
+  secret; no per-peer state is kept. The requester retries with the cookie
+  echoed. A spoofed-source FINDNODE therefore costs the server one HMAC and
+  a reply no larger than the request (no ~10x NODES amplification toward
+  the victim, no attacker-triggered BLS signature verification), and the
+  cookie only ever reaches the true owner of the source address. NODES
+  responses are ingested solicited-only (a forged NODES from a node we
+  asked nothing of is dropped before any signature work). Unsolicited
+  PING/PONG stay one bounded ENR verify per datagram — the
+  eviction-liveness protocol needs them — until the real discv5 session
+  handshake lands behind the transport seam (ROADMAP).
 * **Iterative lookup** — query the α closest known nodes for the target's
   distance, admit returned records, repeat while strictly closer nodes
   appear (bounded rounds). This is how a node bootstrapped from ONE boot
@@ -43,8 +58,11 @@ log = get_logger("discovery")
 K_BUCKET = 16          # discv5 bucket size
 ALPHA = 3              # lookup concurrency
 MAX_LOOKUP_ROUNDS = 8
-_PING, _PONG, _FINDNODE, _NODES = 1, 2, 3, 4
+_PING, _PONG, _FINDNODE, _NODES, _WHOAREYOU = 1, 2, 3, 4, 5
 _MAX_NODES_PER_RESPONSE = 16
+_COOKIE_LEN = 16       # WHOAREYOU cookie bytes (truncated HMAC-SHA256)
+_COOKIE_WINDOW_S = 60  # cookie validity window (current + previous accepted)
+_COOKIE_CACHE_MAX = 1024  # client-side cached cookies (expired pruned first)
 # Liveness-checked eviction (discv5 pending-node semantics): before a full
 # bucket evicts its oldest record, the service PINGs it and only replaces it
 # if no packet arrives within this window. Unconditional LRU eviction lets
@@ -289,7 +307,23 @@ class DiscoveryService:
         # polling, which burned the full timeout whenever a response taught
         # nothing new (already-known records).
         self._pending_requests: dict[bytes, list[threading.Event]] = {}
+        # addr -> outstanding FINDNODE count: lives for the WHOLE request
+        # (unlike _findnode_inflight, which the WHOAREYOU retry consumes) —
+        # the serve loop's NODES gate requires the SOURCE ADDRESS to match
+        # an outstanding request, not just the forgeable node_id
+        self._pending_addrs: dict[tuple, int] = {}
         self._requests_lock = threading.Lock()
+        # stateless WHOAREYOU source-address validation: cookies we hand out
+        # are HMAC(secret, src_addr || time window) — no per-peer state; the
+        # client side caches the cookie each server gave us and remembers
+        # the in-flight FINDNODE body per destination so a WHOAREYOU
+        # challenge can be answered with one retry.
+        self._cookie_secret = secrets.token_bytes(16)
+        # addr -> (cookie, expiry): bounded — entries expire with the server
+        # window and the insert path prunes, so walking the whole DHT
+        # keyspace over a long uptime cannot grow this without limit
+        self._cookies: dict[tuple, tuple[bytes, float]] = {}
+        self._findnode_inflight: dict[tuple, bytes] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -384,6 +418,32 @@ class DiscoveryService:
                 evicted=old_id.hex()[:16], admitted=cand.node_id.hex()[:16],
             )
 
+    # -- stateless source-address cookies ----------------------------------
+
+    def _cookie_for(self, src: tuple, window_offset: int = 0) -> bytes:
+        """The cookie THIS node hands to (and later expects back from) a
+        source address, for the current (or offset) time window. Stateless:
+        derived from the local secret, so validation needs no per-peer
+        bookkeeping and a restart only invalidates outstanding handshakes."""
+        import hashlib
+        import hmac
+
+        w = int(time.time() / _COOKIE_WINDOW_S) + window_offset
+        msg = f"{src[0]}:{src[1]}:{w}".encode()
+        return hmac.new(self._cookie_secret, msg, hashlib.sha256).digest()[
+            :_COOKIE_LEN
+        ]
+
+    def _cookie_ok(self, cookie: bytes, src: tuple) -> bool:
+        import hmac
+
+        if len(cookie) != _COOKIE_LEN:
+            return False
+        return any(
+            hmac.compare_digest(cookie, self._cookie_for(src, -i))
+            for i in (0, 1)
+        )
+
     # -- client side -------------------------------------------------------
 
     def bootstrap(self, boot_enr: ENR) -> bool:
@@ -441,17 +501,39 @@ class DiscoveryService:
         tracking — the serve loop signals the event when the response
         arrives, whether or not it taught any new record). Returns True when
         the peer answered within the timeout."""
-        body = bytes([len(distances)]) + b"".join(
+        inner = bytes([len(distances)]) + b"".join(
             struct.pack(">H", d) for d in distances
         )
+        cached = self._cookies.get(enr.udp_addr)
+        cookie = cached[0] if cached and cached[1] > time.time() else b""
         ev = threading.Event()
         with self._requests_lock:
             self._pending_requests.setdefault(enr.node_id, []).append(ev)
+            # remember the request body so a WHOAREYOU challenge can be
+            # answered by resending with the fresh cookie (last writer wins
+            # for concurrent requests to one peer — both retries carry a
+            # valid body, the answers settle every waiter)
+            self._findnode_inflight[enr.udp_addr] = inner
+            self._pending_addrs[enr.udp_addr] = (
+                self._pending_addrs.get(enr.udp_addr, 0) + 1
+            )
         try:
-            self._send(enr.udp_addr, _FINDNODE, body)
+            self._send(
+                enr.udp_addr, _FINDNODE, bytes([len(cookie)]) + cookie + inner
+            )
             return ev.wait(timeout)
         finally:
             with self._requests_lock:
+                # compare-and-pop: only clear our OWN body — a concurrent
+                # request to the same peer may have overwritten the slot, and
+                # its WHOAREYOU retry still needs it
+                if self._findnode_inflight.get(enr.udp_addr) is inner:
+                    del self._findnode_inflight[enr.udp_addr]
+                n_out = self._pending_addrs.get(enr.udp_addr, 0) - 1
+                if n_out > 0:
+                    self._pending_addrs[enr.udp_addr] = n_out
+                else:
+                    self._pending_addrs.pop(enr.udp_addr, None)
                 evs = self._pending_requests.get(enr.node_id)
                 if evs is not None:
                     # remove only THIS call's event — a concurrent request
@@ -491,22 +573,88 @@ class DiscoveryService:
                 body = data[off + 1 :]
             except (ValueError, IndexError):
                 continue
+            if kind == _FINDNODE:
+                # stateless WHOAREYOU gate BEFORE any ENR signature work: a
+                # FINDNODE without a valid source-address cookie costs this
+                # node one HMAC and a reply no larger than the request —
+                # never a BLS verification, never a NODES payload. A spoofed
+                # source address never sees the cookie, so it can neither
+                # force signature verifies nor aim amplified responses.
+                if not body:
+                    continue
+                ck_len = body[0]
+                if len(body) < 1 + ck_len:
+                    continue
+                cookie, rest = body[1 : 1 + ck_len], body[1 + ck_len :]
+                if not self._cookie_ok(cookie, src):
+                    self._send(src, _WHOAREYOU, self._cookie_for(src))
+                    continue
+                self._note_liveness(sender.node_id)
+                self._admit(sender)
+                self._answer_findnode(src, rest)
+                continue
+            if kind == _WHOAREYOU:
+                self._on_whoareyou(src, body)
+                continue
+            if kind == _NODES:
+                # solicited-only: a NODES packet is dropped BEFORE any ENR
+                # signature work unless BOTH its self-reported node_id has a
+                # FINDNODE outstanding AND it arrives from an address we
+                # sent one to — the node_id alone is attacker-chosen (a
+                # public boot node's id is in its published ENR), so an
+                # id-only gate still buys up to 1 + _MAX_NODES_PER_RESPONSE
+                # BLS verifications per spoofed datagram and falsely
+                # settles the waiters
+                with self._requests_lock:
+                    evs = list(self._pending_requests.get(sender.node_id, ()))
+                    addr_ok = src in self._pending_addrs
+                if not evs or not addr_ok:
+                    continue
             self._note_liveness(sender.node_id)
             self._admit(sender)
             if kind == _PING:
+                # residual unauthenticated surface (documented): one ENR
+                # verify + a tiny PONG per datagram, no amplification. The
+                # eviction-liveness protocol needs unsolicited PING/PONG;
+                # per-packet cost stays one bounded verify until the real
+                # discv5 session handshake lands with the transport seam.
                 self._send(src, _PONG, b"")
-            elif kind == _FINDNODE:
-                self._answer_findnode(src, body)
             elif kind == _NODES:
                 self._ingest_nodes(body)
-                # settle every outstanding FINDNODE to this responder
-                # (after ingest, so the waiters observe the admitted
-                # records)
-                with self._requests_lock:
-                    evs = list(self._pending_requests.get(sender.node_id, ()))
+                # settle every outstanding FINDNODE to this responder only
+                # after ingest, so the waiters observe the admitted records
                 for ev in evs:
                     ev.set()
             # PONG: the ENR admission above is the whole effect
+
+    def _on_whoareyou(self, src: tuple, body: bytes) -> None:
+        """A WHOAREYOU challenge for an in-flight FINDNODE: cache the cookie
+        for the challenger's address and retry the request ONCE — the
+        in-flight body is consumed here, so N challenges (spoofed or real)
+        to one outstanding request yield one resend and one cache write.
+        Challenges from addresses we have nothing outstanding to are
+        dropped. Residual surface: an attacker who spoofs the peer's
+        address WHILE we have a request to it in flight can burn that
+        request's single retry and leave a garbage cookie, costing one
+        extra WHOAREYOU round trip on the next request — bounded by our
+        own request rate, never amplified."""
+        if len(body) != _COOKIE_LEN:
+            return
+        with self._requests_lock:
+            inner = self._findnode_inflight.pop(src, None)
+        if inner is None:
+            return
+        now = time.time()
+        if len(self._cookies) >= _COOKIE_CACHE_MAX:
+            self._cookies = {
+                a: ce for a, ce in self._cookies.items() if ce[1] > now
+            }
+            while len(self._cookies) >= _COOKIE_CACHE_MAX:
+                self._cookies.pop(next(iter(self._cookies)))
+        self._cookies[src] = (bytes(body), now + _COOKIE_WINDOW_S)
+        self._send(
+            src, _FINDNODE, bytes([_COOKIE_LEN]) + bytes(body) + inner
+        )
 
     def _answer_findnode(self, src: tuple, body: bytes) -> None:
         try:

@@ -444,12 +444,15 @@ def _conv_product(a, b):
 
 
 # Row threshold for keeping the reduction walk in f64 (f64 backend only).
-# Above it the walk is compute-bound and f64 SIMD FMAs (+ the matmul fold)
-# beat the scalarized u64 multiplies (~1.6x on a batch-8 G2 point-double, 80
-# rows); below it the walk is pass-count-bound and the f64 schedule (longer
-# under the 2^53 cap) loses. Static per-call-site dispatch — both paths are
-# exact.
-F64_WALK_MIN_ROWS = 32
+# Originally 32: host-dispatched micro-benchmarks suggested the longer f64
+# schedule (2^53 cap) loses below ~32 rows. Re-measured inside lax.scan
+# bodies (where the pairing's batch-1 final-exponentiation chains actually
+# run, and dispatch cost amortizes away) the u64 path's scalarized
+# multiplies lose at EVERY row count — a 63-step cyclotomic-square scan at
+# batch 1 ran 2.3x faster on the f64 path — so the threshold is now 0:
+# the f64 backend keeps the whole execute pipeline in f64 SIMD at all
+# shapes. Static per-call-site dispatch — both paths are exact.
+F64_WALK_MIN_ROWS = 0
 
 
 def _static_rows(a) -> int:

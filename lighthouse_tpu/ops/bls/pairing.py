@@ -1,22 +1,34 @@
-"""Optimal-ate pairing on BLS12-381 as JAX device kernels.
+"""Optimal-ate pairing on BLS12-381, compiled through the chain-plan machinery.
 
 The TPU twin of the pairing engine blst provides to the reference's batch
-verifier (``/root/reference/crypto/bls/src/impls/blst.rs:37-119``). Design:
+verifier (``/root/reference/crypto/bls/src/impls/blst.rs:37-119``). Since the
+BLS parameter |x| = 0xd201000000010000 is a host constant, BOTH pairing stages
+are *fixed* schedules, and the whole endgame is compiled the way
+``ops/bls/chain_plans.py`` compiles fixed scalars:
 
-  * **Miller loop**: homogeneous-projective doubling/addition steps on the
-    M-type twist (Costello–Lange–Naehrig formulas, two_inv eliminated by a
-    uniform projective rescale), producing sparse line coefficients that fold
-    into the Fq12 accumulator via a dedicated 39-lane ``mul_by_014`` plan.
-    Denominator/subfield factors introduced by rescaling live in Fq2 and are
-    annihilated by the easy part of the final exponentiation.
-  * **Loop structure**: the BLS parameter |x| = 0xd201000000010000 has Hamming
-    weight 6, so the 63-step loop is host-segmented into runs of pure doubling
-    (each one ``lax.scan`` over a shared branchless body) with the 5 addition
-    steps unrolled in between — no per-step conditionals on device.
-  * **Batching**: every op broadcasts over leading axes; a batch of pairings is
-    one Miller loop over stacked points, the product is a halving fq12_mul
-    tree, and the whole check costs ONE final exponentiation (same shape as
-    blst's ``verify_multiple_aggregate_signatures``).
+  * **Planned Miller loop** (two passes over the trace-time |x| schedule):
+    pass 1 iterates ONLY the twist point — each doubling step is a dedicated
+    two-level plan pair (CLN homogeneous-projective formulas with every
+    linear step folded into the plan lincombs, 21 lanes total, line
+    coefficients emitted through pass-through rows) collecting the 63
+    doubling + 5 addition line coefficients. All 68 lines are then scaled by
+    the G1 coordinates in ONE stacked plan execution, and the 5 addition
+    lines are pre-multiplied into their doubling-step partners (sparse 014 x
+    014 -> 01245) in one more stacked kernel, so the accumulator pass is a
+    uniform run of ``f^2 * line`` folds. Pass 2 walks the accumulator under
+    lazy fq12-interior bounds (plans.F12_BOUND: value < 64p, 18-bit limbs —
+    the certifier-proved fixed point of fq12 chain steps) and only the loop
+    output pays the full public-bound walk.
+  * **Planned final exponentiation**: the hard part keeps the x-addition
+    chain (its five |x|-exponentiations are data-sequential — each feeds the
+    next — and |x|'s weight-6 sparsity makes the per-factor chains optimal),
+    but every exponentiation runs as one ``chain_plans`` schedule with lazy
+    interiors, and cyclotomic squaring has an opt-in Karabina compressed
+    kernel (``tower.fq12_compressed_sqr``, LIGHTHOUSE_PAIRING_KARABINA=1).
+  * **Batching**: every op broadcasts over leading axes; a batch of pairings
+    is one Miller loop over stacked points, the product is a halving
+    fq12_mul tree, and the whole check costs ONE final exponentiation (same
+    shape as blst's ``verify_multiple_aggregate_signatures``).
 
 Correctness is pinned against ``ops.bls_oracle.pairing`` (values agree after
 final exponentiation; both compute e(P,Q)^3 — the harmless cube of the
@@ -31,11 +43,13 @@ import jax
 import jax.numpy as jnp
 
 from . import fq, plans, tower
-from .plans import LC, PUB_BOUND, v2_add, v2_sub, v2_nr
+from .plans import LC, PUB_BOUND, F12_BOUND, v2_add, v2_sub, v2_nr, v6_add, v6_sub, v6_nr
 from ..bls_oracle.fields import BLS_X
 
+X_ABS = -BLS_X  # 0xd201000000010000
+
 # --------------------------------------------------------------------------------------
-# Sparse fold plan: f * (c0 + c1 v + c4 v w)   [Fq6-slot positions 0, 1, 4]
+# Sparse fold plans
 # --------------------------------------------------------------------------------------
 
 
@@ -62,6 +76,20 @@ def _mul6_sp1(p: plans.Plan, xs, d):
     return v2_nr(n2) + n0 + n1
 
 
+def _mul6_sp12(p: plans.Plan, xs, d1, d2):
+    """Karatsuba fq6 * (0, d1, d2) — 5 mul2 lanes."""
+    x0, x1, x2 = xs[0:2], xs[2:4], xs[4:6]
+    m01 = p.mul2(x0, d1)
+    m02 = p.mul2(x0, d2)
+    m11 = p.mul2(x1, d1)
+    m22 = p.mul2(x2, d2)
+    mx = p.mul2(v2_add(x1, x2), v2_add(d1, d2))
+    r0 = v2_nr(v2_sub(v2_sub(mx, m11), m22))
+    r1 = v2_add(m01, v2_nr(m22))
+    r2 = v2_add(m02, m11)
+    return r0 + r1 + r2
+
+
 def _build_mul_by_014() -> plans.Plan:
     """A-side: full fq12 (12 coeffs). B-side: 6 coeffs [c0 | c1 | c4]."""
     p = plans.Plan(12, 6)
@@ -82,75 +110,179 @@ def _build_mul_by_014() -> plans.Plan:
 MUL_BY_014 = _build_mul_by_014()
 
 
+def _build_mul_by_01245() -> plans.Plan:
+    """A-side: full fq12. B-side: 10 coeffs [c0|c1|c2|c4|c5] — the product of
+    two scaled 014-lines (every fq6 slot except w-slot 0). 51 lanes."""
+    p = plans.Plan(12, 10)
+    x = plans.vbasis(12)
+    a0, a1 = x[0:6], x[6:12]
+    b0 = plans.vbasis(6)              # [c0 | c1 | c2]
+    d1 = [LC.basis(6), LC.basis(7)]   # c4
+    d2 = [LC.basis(8), LC.basis(9)]   # c5
+    t0 = p.mul6(a0, b0)
+    t1 = _mul6_sp12(p, a1, d1, d2)
+    ysum = b0[0:2] + v2_add(b0[2:4], d1) + v2_add(b0[4:6], d2)
+    t2 = p.mul6(v6_add(a0, a1), ysum)
+    out0 = v6_add(t0, v6_nr(t1))
+    out1 = v6_sub(v6_sub(t2, t0), t1)
+    p.out_rows = out0 + out1
+    return p
+
+
+MUL_BY_01245 = _build_mul_by_01245()
+
+
+def _build_sp_sp() -> plans.Plan:
+    """Product of two scaled lines: (a0 + a1 v + a4 vw)(b0 + b1 v + b4 vw) ->
+    [c0|c1|c2|c4|c5] (slot 3 provably zero). 18 Karatsuba lanes."""
+    p = plans.Plan(6, 6)
+    x, y = plans.vbasis(6), plans.vbasis(6)
+    a0, a1, a4 = x[0:2], x[2:4], x[4:6]
+    b0, b1, b4 = y[0:2], y[2:4], y[4:6]
+    m00 = p.mul2(a0, b0)
+    m11 = p.mul2(a1, b1)
+    m44 = p.mul2(a4, b4)
+    mx01 = p.mul2(v2_add(a0, a1), v2_add(b0, b1))
+    mx04 = p.mul2(v2_add(a0, a4), v2_add(b0, b4))
+    mx14 = p.mul2(v2_add(a1, a4), v2_add(b1, b4))
+    c0 = v2_add(m00, v2_nr(m44))
+    c1 = v2_sub(v2_sub(mx01, m00), m11)
+    c2 = m11
+    c4 = v2_sub(v2_sub(mx04, m00), m44)
+    c5 = v2_sub(v2_sub(mx14, m11), m44)
+    p.out_rows = c0 + c1 + c2 + c4 + c5
+    return p
+
+
+SP_SP = _build_sp_sp()
+
+
+def _build_scale_line() -> plans.Plan:
+    """A-side: unscaled line [c0|c1|c2]. B-side: [px|py] (fq coefficients).
+    Output [c0 | c1*px | c2*py] — mul_by_014's sparse operand layout. c0
+    passes through; 4 lanes."""
+    p = plans.Plan(6, 2)
+    px, py = LC.basis(0), LC.basis(1)
+    l10 = p.lane(LC.basis(2), px)
+    l11 = p.lane(LC.basis(3), px)
+    l20 = p.lane(LC.basis(4), py)
+    l21 = p.lane(LC.basis(5), py)
+    p.out_rows = [p.inp(0), p.inp(1), l10, l11, l20, l21]
+    return p
+
+
+SCALE_LINE = _build_scale_line()
+
+
 def mul_by_014(f, c):
     """f [..., 12, 25] times the sparse element with Fq2 coefficients
     c = [c0 | c1 | c4] [..., 6, 25] at Fq6-slot positions 0, 1, 4."""
     return plans.execute(MUL_BY_014, f, c, PUB_BOUND, PUB_BOUND, "mul014")
 
 
-# --------------------------------------------------------------------------------------
-# Miller-loop steps (CLN homogeneous projective, two_inv cleared by 4x rescale)
-# --------------------------------------------------------------------------------------
+def mul_by_01245(f, c):
+    """f times the 10-coefficient sparse element [c0|c1|c2|c4|c5] (a product
+    of two lines)."""
+    return plans.execute(MUL_BY_01245, f, c, PUB_BOUND, PUB_BOUND, "mul01245")
 
-_B2 = PUB_BOUND.scaled(2)
+
+def _mul014_lazy(f, c):
+    bd, ob = plans.f12_interior()
+    return plans.execute(MUL_BY_014, f, c, bd, bd, "mul014_c", out_bound=ob)
+
+
+def _mul01245_lazy(f, c):
+    bd, ob = plans.f12_interior()
+    return plans.execute(MUL_BY_01245, f, c, bd, bd, "mul01245_c", out_bound=ob)
+
+
+# --------------------------------------------------------------------------------------
+# Miller-loop step plans (CLN homogeneous projective, two_inv cleared by 4x rescale)
+# --------------------------------------------------------------------------------------
+#
+# The doubling step is two dedicated plans with ALL linear glue (h, e, b+-3e,
+# the line coefficients) folded into lincombs/pass-through rows — no separate
+# carry_norm or lazy-add traffic between kernels, and both levels run at the
+# lazy F12_BOUND interior:
+#
+#   Level 1: lanes a' = XY, b = Y^2, c = Z^2, j = X^2, s = (Y+Z)^2 (11 lanes);
+#     rows  [a', b - 3e, b + 3e, e, b, h, j] with e = 12 nr(c), h = s - b - c.
+#   Level 2: lanes m0 = a'(b - 3e), m1 = (b + 3e)^2, m2 = e^2, m3 = b h
+#     (10 lanes); rows X3 = 2 m0, Y3 = m1 - 12 m2, Z3 = 4 m3 and the line
+#     (e - b, 3j, -h) through pass-through references.
+
+
+def _build_dbl_plans() -> tuple[plans.Plan, plans.Plan]:
+    p1 = plans.Plan(6, 6)
+    x = plans.vbasis(6)
+    X, Y, Z = x[0:2], x[2:4], x[4:6]
+    aj = p1.mul2(X, Y)
+    b = p1.sqr2(Y)
+    c = p1.sqr2(Z)
+    j = p1.sqr2(X)
+    s = p1.sqr2(v2_add(Y, Z))
+    e = [t.scale(12) for t in v2_nr(c)]
+    e3 = [t.scale(3) for t in e]
+    bmf = v2_sub(b, e3)
+    bpf = v2_add(b, e3)
+    h = v2_sub(v2_sub(s, b), c)
+    p1.out_rows = aj + bmf + bpf + e + b + h + j
+
+    p2 = plans.Plan(14, 14)
+    y = plans.vbasis(14)
+    aj2, bmf2, bpf2, e2, b2, h2 = (
+        y[0:2], y[2:4], y[4:6], y[6:8], y[8:10], y[10:12]
+    )
+    m0 = p2.mul2(aj2, bmf2)
+    m1 = p2.sqr2(bpf2)
+    m2 = p2.sqr2(e2)
+    m3 = p2.mul2(b2, h2)
+    x3 = [t.scale(2) for t in m0]
+    y3 = v2_sub(m1, [t.scale(12) for t in m2])
+    z3 = [t.scale(4) for t in m3]
+    l0 = [p2.inp(6) - p2.inp(8), p2.inp(7) - p2.inp(9)]      # e - b
+    l1 = [p2.inp(12).scale(3), p2.inp(13).scale(3)]          # 3 j
+    l2 = [-p2.inp(10), -p2.inp(11)]                          # -h
+    p2.out_rows = x3 + y3 + z3 + l0 + l1 + l2
+    return p1, p2
+
+
+DBL1, DBL2 = _build_dbl_plans()
 
 
 def _dbl_step(r):
-    """r = (X:Y:Z) on the twist -> (4-scaled doubled point, line [c0|c1|c2]).
-
-    Level 1: a' = XY, b = Y^2, c = Z^2, j = X^2, s = (Y+Z)^2.
-    Linear:  h = s - b - c, e = 12 nr(c) (= 3 b' c for b' = 4(u+1)), f3 = 3e.
-    Level 2: m0 = a'(b - f3), m1 = (b + f3)^2, m2 = e^2, m3 = b h.
-    Out:     X3 = 2 m0, Y3 = m1 - 12 m2, Z3 = 4 m3; line = (e - b, 3j, -h).
-    """
-    x, y, z = r[..., 0:2, :], r[..., 2:4, :], r[..., 4:6, :]
-    aj, b, c, j, s = tower.fq2_mul_many(
-        [(x, y), (y, y), (z, z), (x, x), (y + z, y + z)], in_bound=_B2
-    )
-    h = tower.t_sub(tower.t_sub(s, b), c)
-    h_b = plans.sub_bound(plans.sub_bound(PUB_BOUND, PUB_BOUND), PUB_BOUND)
-    e = plans.carry_norm(tower.fq2_mul_by_nonresidue(c) * np.uint64(12))
-    f3 = e * np.uint64(3)
-    bmf = tower.t_sub(b, f3, PUB_BOUND.scaled(3))
-    bpf = b + f3
-    lvl2_b = plans.sub_bound(PUB_BOUND, PUB_BOUND.scaled(3)) | PUB_BOUND.scaled(4) | h_b
-    m0, m1, m2, m3 = tower.fq2_mul_many(
-        [(aj, bmf), (bpf, bpf), (e, e), (b, plans.carry_norm(h))], in_bound=lvl2_b
-    )
-    out = jnp.concatenate(
-        [
-            m0 * np.uint64(2),                                      # X3
-            tower.t_sub(m1, m2 * np.uint64(12), PUB_BOUND.scaled(12)),  # Y3
-            m3 * np.uint64(4),                                      # Z3
-            tower.t_sub(e, b),                                      # line c0 = e - b
-            j * np.uint64(3),                                       # line c1 = 3j
-            tower.t_neg(plans.carry_norm(h)),                       # line c2 = -h
-        ],
-        axis=-2,
-    )
-    out = plans.carry_norm(out)
+    """r = (X:Y:Z) on the twist (F12-bounded) -> (4-scaled doubled point,
+    unscaled line [c0|c1|c2]), both F12-bounded."""
+    bd, ob = plans.f12_interior()
+    mid = plans.execute(DBL1, r, r, bd, bd, "mldbl1", out_bound=ob)
+    out = plans.execute(DBL2, mid, mid, bd, bd, "mldbl2", out_bound=ob)
     return out[..., 0:6, :], out[..., 6:12, :]
 
 
 def _add_step(r, qx, qy):
-    """Mixed addition r + Q (Q affine on the twist) -> (new point, line).
+    """Mixed addition r + Q (Q affine on the twist) -> (new point, unscaled
+    line). Runs only at the 5 set bits of |x|; r may be F12-bounded.
 
     theta = Y - qy Z, lam = X - qx Z; c = theta^2, d = lam^2; e = lam d,
     f = Z c, g = X d; h = e + f - 2g; X3 = lam h, Y3 = theta (g - h) - e Y,
     Z3 = Z e; line = (theta qx - lam qy, -theta, lam).
     """
+    B = plans.f12_interior()[0]
     x, y, z = r[..., 0:2, :], r[..., 2:4, :], r[..., 4:6, :]
-    qyz, qxz = tower.fq2_mul_many([(qy, z), (qx, z)])
+    qyz, qxz = tower.fq2_mul_many([(qy, z), (qx, z)], in_bound=B)
     pre = plans.carry_norm(
-        jnp.concatenate([tower.t_sub(y, qyz), tower.t_sub(x, qxz)], axis=-2)
+        jnp.concatenate(
+            [tower.t_sub(y, qyz, B), tower.t_sub(x, qxz, B)], axis=-2
+        )
     )
     theta, lam = pre[..., 0:2, :], pre[..., 2:4, :]
     c, d = tower.fq2_mul_many([(theta, theta), (lam, lam)])
-    e, f, g = tower.fq2_mul_many([(lam, d), (z, c), (x, d)])
+    e, f, g = tower.fq2_mul_many([(lam, d), (z, c), (x, d)], in_bound=B)
     h = plans.carry_norm(tower.t_sub(e + f, g * np.uint64(2), PUB_BOUND.scaled(2)))
     gmh = plans.carry_norm(tower.t_sub(g, h))
     x3, t1, t2, z3, j1, j2 = tower.fq2_mul_many(
-        [(lam, h), (theta, gmh), (e, y), (z, e), (theta, qx), (lam, qy)]
+        [(lam, h), (theta, gmh), (e, y), (z, e), (theta, qx), (lam, qy)],
+        in_bound=B,
     )
     out = jnp.concatenate(
         [
@@ -167,63 +299,206 @@ def _add_step(r, qx, qy):
     return out[..., 0:6, :], out[..., 6:12, :]
 
 
-def _ell(f, line, pxy2):
-    """Fold a line into f: f * (c0, c1 px, c2 py). pxy2 [..., 4, 25] is the
-    precomputed [px, px, py, py] broadcast block (Montgomery, canonical)."""
-    scaled = fq.mont_mul(line[..., 2:6, :], pxy2)
-    c = jnp.concatenate([line[..., 0:2, :], scaled], axis=-2)
-    return mul_by_014(f, c)
-
-
 # --------------------------------------------------------------------------------------
-# Miller loop driver (host-segmented over the weight-6 |x|)
+# Miller loop driver (trace-time |x| schedule, two passes)
 # --------------------------------------------------------------------------------------
 
-X_ABS = -BLS_X  # 0xd201000000010000
+
+def _expand_01245(m):
+    """[..., 10, 25] sparse [c0|c1|c2|c4|c5] -> full fq12 (slot 3 zero)."""
+    z = jnp.zeros_like(m[..., 0:2, :])
+    return jnp.concatenate(
+        [m[..., 0:6, :], z, m[..., 6:8, :], m[..., 8:10, :]], axis=-2
+    )
+
+
+def _expand_014(c):
+    """[..., 6, 25] sparse [c0|c1|c4] -> full fq12 (slots 2, 3, 5 zero)."""
+    z = jnp.zeros_like(c[..., 0:2, :])
+    return jnp.concatenate(
+        [c[..., 0:4, :], z, z, c[..., 4:6, :], z], axis=-2
+    )
+
+
+def _fold_walk(f, lines):
+    """f <- (f^2) * line over the leading axis of ``lines`` — the uniform
+    doubling-position accumulator body, all at F12_BOUND interiors."""
+
+    def body(g, ln):
+        g = tower.fq12_sqr_lazy(g)
+        return _mul014_lazy(g, ln), None
+
+    f, _ = jax.lax.scan(body, f, lines)
+    return f
+
+
+def _collect_lines(px, py, qx, qy):
+    """Pass 1 of the planned Miller loop: iterate ONLY the twist point over
+    the trace-time |x| schedule, collect the 63 doubling + 5 addition lines,
+    and scale all 68 by the G1 coordinates in one stacked plan execution.
+    Returns (segs, add_pos, sd, sa): the schedule, the doubling positions
+    paired with an addition, the scaled doubling lines [63, *batch, 6, 25]
+    and the scaled addition lines [5, *batch, 6, 25] — line operands at the
+    backend's fq12 interior bound."""
+    from .curve import fixed_schedule
+
+    segs = fixed_schedule(X_ABS)
+    assert segs[0] == (1, 1), "BLS |x| starts 0b11"
+    batch = qx.shape[:-2]
+    bd, ob = plans.f12_interior()
+
+    r = jnp.concatenate([qx, qy, tower.one(2, batch)], axis=-2)
+
+    def dbl_body(rr, _):
+        rr2, line = _dbl_step(rr)
+        return rr2, line
+
+    dbl_lines = []
+    add_lines = []
+    for run, add in segs:
+        r, ls = jax.lax.scan(dbl_body, r, None, length=run)
+        dbl_lines.append(ls)
+        if add:
+            r, la = _add_step(r, qx, qy)
+            add_lines.append(la)
+    dbl_lines = jnp.concatenate(dbl_lines, axis=0)   # [63, *batch, 6, 25]
+    add_lines = jnp.stack(add_lines, axis=0)         # [5, *batch, 6, 25]
+
+    # ---- one stacked scaling of all 68 lines by the G1 coordinates
+    pxy = jnp.stack([px, py], axis=-2)               # [*batch, 2, 25]
+    all_lines = jnp.concatenate([dbl_lines, add_lines], axis=0)
+    scaled = plans.execute(
+        SCALE_LINE,
+        all_lines,
+        jnp.broadcast_to(pxy, all_lines.shape[:1] + pxy.shape),
+        bd,
+        PUB_BOUND,
+        "ml_scale",
+        out_bound=ob,
+    )
+    ends = np.cumsum([run for run, _ in segs])
+    add_pos = [int(e) - 1 for e, (_, a) in zip(ends, segs) if a]
+    return (
+        segs, add_pos,
+        scaled[: dbl_lines.shape[0]], scaled[dbl_lines.shape[0] :],
+    )
+
+
+def _conj_norm(f):
+    """x < 0: conjugate the walked accumulator; restore the public bound."""
+    bd = plans.f12_interior()[0]
+    f = jnp.concatenate(
+        [f[..., 0:6, :], tower.t_neg(f[..., 6:12, :], bd)], axis=-2
+    )
+    return plans.carry_norm(f)
 
 
 def miller_loop(px, py, qx, qy):
     """Unreduced pairing f_{x,Q}(P) for P = (px, py) in G1 affine (each
-    [..., 25], Montgomery) and Q = (qx, qy) in G2 affine on the twist (each
-    [..., 2, 25]). Returns fq12 [..., 12, 25]. Infinity inputs produce garbage
-    — callers mask (branchless integer arithmetic, no NaNs).
+    [..., 25], canonical) and Q = (qx, qy) in G2 affine on the twist (each
+    [..., 2, 25]). Returns fq12 [..., 12, 25], public-bounded. Infinity
+    inputs produce garbage — callers mask (branchless integer arithmetic).
 
-    Loop structure: the 63-step walk over |x|'s bits runs as ONE lax.scan over
-    the (doubling_run, add_flag) segment schedule — a dynamic-count fori_loop
-    of the shared doubling body plus a masked addition step. Runtime matches
-    the sparse form (63 dbl, 5 add — |x| has weight 6) while compiling a
-    single body instead of unrolling each segment into the program."""
-    from .curve import fixed_schedule
+    Two passes over the trace-time |x| schedule (see module docstring):
+    point-only line collection, one stacked line scaling, one stacked
+    addition-line pre-multiply, then the lazy-interior accumulator walk."""
+    segs, add_pos, sd, sa = _collect_lines(px, py, qx, qy)
+    bd, ob = plans.f12_interior()
 
-    batch = qx.shape[:-2]
-    pxy2 = jnp.stack([px, px, py, py], axis=-2)
-    # varying-safe initial state: derive from inputs (shard_map scan vma)
-    f = tower.one(12, batch) + qx[..., 0:1, :] * jnp.uint64(0)
-    r = jnp.concatenate([qx, qy, tower.one(2, batch)], axis=-2)
+    # ---- pre-multiply each addition line into its doubling partner
+    merged = plans.execute(
+        SP_SP, sd[jnp.asarray(add_pos)], sa, bd, bd, "ml_spsp", out_bound=ob,
+    )                                                # [5, *batch, 10, 25]
 
-    def dbl_body(_, carry):
-        f, r = carry
-        f = tower.fq12_sqr(f)
-        r, line = _dbl_step(r)
-        f = _ell(f, line, pxy2)
-        return f, r
+    # ---- pass 2: accumulator walk (init consumes the leading 11 bits of |x|)
+    f = _expand_01245(merged[0])
+    mi = 1
+    start = segs[0][0]
+    for run, add in segs[1:]:
+        n_plain = run - (1 if add else 0)
+        if n_plain:
+            f = _fold_walk(f, sd[start : start + n_plain])
+        if add:
+            f = _mul01245_lazy(tower.fq12_sqr_lazy(f), merged[mi])
+            mi += 1
+        start += run
+    return _conj_norm(f)
 
-    segs = fixed_schedule(X_ABS)
-    runs = jnp.asarray([s for s, _ in segs], dtype=jnp.int32)
-    adds = jnp.asarray([a for _, a in segs], dtype=jnp.int32)
 
-    def seg_body(carry, seg):
-        run, addf = seg
-        f, r = jax.lax.fori_loop(0, run, dbl_body, carry)
-        ra, line = _add_step(r, qx, qy)
-        fa = _ell(f, line, pxy2)
-        f = tower.t_select(jnp.broadcast_to(addf == 1, f.shape[:-2]), fa, f)
-        r = tower.t_select(jnp.broadcast_to(addf == 1, r.shape[:-2]), ra, r)
-        return (f, r), None
+def _cross_pair_products(lines, valid=None):
+    """Per-position products of the n pairs' scaled lines: [P, n, 6, 25]
+    sparse-014 operands -> [P, 12, 25] full fq12, at interior bounds.
 
-    (f, r), _ = jax.lax.scan(seg_body, (f, r), (runs, adds))
-    # x < 0: conjugate
-    return tower.fq12_conj(f)
+    One batched sparse SP_SP level (every 014 x 014 product costs 18 lanes
+    instead of a 54-lane dense multiply), then a halving fq12_mul tree, then
+    one sparse 014-fold of the odd leftover line — log2(n) + 2 stacked plan
+    executions covering ALL positions. ``valid`` masks pairs by replacing
+    their lines with the identity line (c0 = 1)."""
+    if valid is not None:
+        ident = jnp.concatenate(
+            [
+                tower.one(2, lines.shape[:2]),
+                jnp.zeros_like(lines[..., 0:4, :]),
+            ],
+            axis=-2,
+        )
+        mask = jnp.broadcast_to(valid[None], lines.shape[:2])
+        lines = tower.t_select(mask, lines, ident)
+    n = lines.shape[1]
+    if n == 1:
+        return _expand_014(lines[:, 0])
+    bd, ob = plans.f12_interior()
+    half = n // 2
+    leftover = lines[:, -1] if n % 2 else None
+    sp = plans.execute(
+        SP_SP, lines[:, :half], lines[:, half : 2 * half], bd, bd,
+        "ml_spsp", out_bound=ob,
+    )
+    L = _expand_01245(sp)                             # [P, half, 12, 25]
+    m = L.shape[1]
+    while m > 1:
+        h = m // 2
+        prod = tower.fq12_mul_lazy(L[:, :h], L[:, h : 2 * h])
+        if m % 2:
+            prod = jnp.concatenate([prod, L[:, 2 * h :]], axis=1)
+        L = prod
+        m = L.shape[1]
+    L = L[:, 0]
+    if leftover is not None:
+        L = _mul014_lazy(L, leftover)
+    return L
+
+
+def miller_loop_product(px, py, qx, qy, valid=None):
+    """prod_i f_{x,Q_i}(P_i) over the LEADING batch axis with ONE shared
+    accumulator (blst's aggregate-verify shape): every pairing in the
+    product squares its accumulator on the same |x| schedule, so the product
+    squares a single fq12 once per step and folds each step's cross-pair
+    line product as one full element — the O(n) accumulator squarings of n
+    batched Miller loops collapse to O(1), and the line products themselves
+    are sparse-first batched trees (_cross_pair_products) over all 68 line
+    positions at once.
+
+    Pass 1 (per-pair point iteration + stacked scaling) is shared with
+    ``miller_loop``; the walk is a single uniform ``f <- f^2 * L[i]`` scan
+    at batch 1. ``valid`` masks pairs (an invalid pair's lines become one,
+    so it contributes nothing to the product)."""
+    segs, add_pos, sd, sa = _collect_lines(px, py, qx, qy)
+
+    # [68, 12, 25]: per-position cross-pair products (63 dbl + 5 add)
+    L = _cross_pair_products(jnp.concatenate([sd, sa], axis=0), valid)
+    # fold each addition-position product into its doubling partner, so the
+    # walk is uniform (one squaring, one multiply per position)
+    ap = jnp.asarray(add_pos)
+    Lm = tower.fq12_mul_lazy(L[ap], L[63:])
+    Ld = L[:63].at[ap].set(Lm)
+
+    def body(g, ln):
+        g = tower.fq12_sqr_lazy(g)
+        return tower.fq12_mul_lazy(g, ln), None
+
+    f, _ = jax.lax.scan(body, Ld[0], Ld[1:])
+    return _conj_norm(f)
 
 
 # --------------------------------------------------------------------------------------
@@ -233,7 +508,13 @@ def miller_loop(px, py, qx, qy):
 
 def final_exponentiation(f):
     """f^((p^6-1)(p^2+1)) then the hard part f^(3 (p^4 - p^2 + 1)/r) via
-    3λ = (x-1)^2 (x+p) (x^2 + p^2 - 1) + 3 (mirrors the oracle chain)."""
+    3λ = (x-1)^2 (x+p) (x^2 + p^2 - 1) + 3 (mirrors the oracle chain).
+
+    The five |x|-exponentiations are data-sequential (each feeds the next —
+    the x-addition chain is the optimal factorization for the weight-6 |x|),
+    but each one is a single compiled chain-plan scan with lazy fq12
+    interiors (see tower.fq12_cyclotomic_exp_abs_x); the Frobenius/conjugate
+    glue and the f^3 term run at chain boundaries."""
     f = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
     f = tower.fq12_mul(tower.fq12_frobenius(f, 2), f)  # cyclotomic now
 
@@ -273,10 +554,32 @@ def pairing(px, py, qx, qy):
     return final_exponentiation(miller_loop(px, py, qx, qy))
 
 
-def multi_pairing_is_one(px, py, qx, qy, valid=None):
-    """prod_i e(P_i, Q_i) == 1 over the leading batch axis with ONE final
-    exponentiation. ``valid`` masks entries (invalid -> contributes one)."""
+def miller_product(px, py, qx, qy, valid=None):
+    """Unreduced prod_i f_{x,Q_i}(P_i) over the leading batch axis — the
+    verify path's Miller stage, dispatched by conv backend at trace time:
+
+    * digits (TPU): the shared-accumulator ``miller_loop_product`` — conv
+      lane counts dominate there, and collapsing the n per-pair accumulator
+      squarings to one plus sparse-first cross-pair line trees is a strict
+      lane win;
+    * f64 (CPU): independent batched accumulators + a halving product tree —
+      measured FASTER below ~dozens of pairs (at the 9-pair verify shape the
+      cross-pair trees' dense fq12 multiplies at shrinking batch widths cost
+      more than the n-1 extra squarings they avoid, which SIMD over the
+      batch axis makes nearly free).
+    """
+    if fq.conv_backend() == "digits":
+        return miller_loop_product(px, py, qx, qy, valid)
     fs = miller_loop(px, py, qx, qy)
     if valid is not None:
         fs = tower.t_select(valid, fs, tower.one(12, fs.shape[:-2]))
-    return tower.fq12_is_one(final_exponentiation(fq12_prod(fs)))
+    return fq12_prod(fs)
+
+
+def multi_pairing_is_one(px, py, qx, qy, valid=None):
+    """prod_i e(P_i, Q_i) == 1 over the leading batch axis with ONE final
+    exponentiation; the Miller stage is the backend-dispatched
+    ``miller_product``. ``valid`` masks entries (invalid -> contributes
+    one)."""
+    f = miller_product(px, py, qx, qy, valid)
+    return tower.fq12_is_one(final_exponentiation(f))

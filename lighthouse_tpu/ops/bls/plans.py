@@ -285,6 +285,37 @@ CANON_BOUND = _Bound(1, (1 << 16) - 1, 0)  # canonical values are exact 16-bit
 CHAIN_BOUND = _Bound(
     fq.CHAIN_VALUE_P, fq.CHAIN_LIMB_TARGET, fq.chain_top_limb()
 )
+# Lazy fq12-interior bound for the pairing chains (Miller accumulator, the
+# final exponentiation's cyclotomic runs). CHAIN_BOUND's 20-bit limbs are too
+# wide here: the fq12/fq6 plans' input lincombs sum up to ~4 coefficient
+# magnitudes plus a borrow-inflated constant, so 2^20-limb inputs would
+# overflow the 2^22 conv-input budget. 18-bit limbs at the same 64p value
+# compose through every fq12-level lincomb within budget (asserted per plan
+# at build time, certified by analysis/bounds.py) while still trimming the
+# tail of the reduction walk versus PUB_BOUND (value 64p vs 13p, limbs 2^18
+# vs 2^17). The top-limb bound is the same derivation as chain_top_limb():
+# limbs are non-negative, so limb 24 <= value >> 384.
+F12_BOUND = _Bound(
+    fq.CHAIN_VALUE_P,
+    (1 << 18) - 1,
+    min((1 << 18) - 1, fq.CHAIN_VALUE_LIMIT >> (16 * 24)),
+)
+assert F12_BOUND.limb <= fq.CHAIN_LIMB_TARGET <= fq._IN_LIMB
+
+
+def f12_interior():
+    """(in/out bound, out_bound kwarg) for fq12 chain interiors, by backend.
+
+    On the digits backend the conv accumulator bound is set by the base-2^8
+    digit split (~2^32.6) regardless of input limb width, so running chain
+    interiors at F12_BOUND is free on the way in and trims the walk tail on
+    the way out. On the f64 backend the accumulator bound grows with the
+    input limbs (25 * limb^2): F12_BOUND's extra input bit costs MORE fold
+    rounds than its looser target saves (measured ~15% slower per fq12 op),
+    so interiors stay at PUB_BOUND and the walk kwarg stays default."""
+    if fq.conv_backend() == "digits":
+        return F12_BOUND, F12_BOUND
+    return PUB_BOUND, None
 
 
 def _lincomb_bounds(rows: list[LC], bound_for, name: str):

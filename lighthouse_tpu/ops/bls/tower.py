@@ -14,6 +14,8 @@ Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3-(u+1)), Fq12 = Fq6[w]/(w^2-v).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -447,28 +449,213 @@ def fq12_cyclotomic_sqr(a, in_bound=PUB_BOUND):
     return plans.execute(plans.CYC_SQR, a, a, in_bound, in_bound, "cyc_sqr")
 
 
-def fq12_cyclotomic_exp_abs_x(a):
-    """a^|x| (|x| = 0xd201000000010000, popcount 6): the exponent is fixed at
-    trace time, so zero bits are squarings only — 63 cyc_sqr + 5 fq12_mul
-    instead of the ladder's 63 x (cyc_sqr + mul + select). Final
-    exponentiation calls this 5 times; the segment schedule runs as one
-    lax.scan (dynamic-count cyc-sqr fori_loop + masked multiply) so each call
-    site compiles a single (sqr + mul) body instead of unrolling the chain."""
+# Lazy fq12 chain interiors: on conv-bound backends (digits) the pairing
+# accumulator and the final exponentiation's cyclotomic runs keep their
+# values at plans.F12_BOUND (18-bit limbs / < 64p) between multiplies,
+# paying the full PUB_BOUND walk only at chain boundaries; on the f64 CPU
+# path the wider inputs cost more fold rounds than the lazier target saves,
+# so plans.f12_interior() resolves these to plain PUB_BOUND ops there.
+# CHAIN_BOUND itself (20-bit limbs) would overflow the fq12 plans'
+# input-lincomb budget — see the F12_BOUND derivation note in plans.py.
+
+def fq12_mul_lazy(a, b, in_bound=None):
+    bd, ob = plans.f12_interior()
+    bd = in_bound or bd
+    return plans.execute(plans.MUL12, a, b, bd, bd, "fq12_mul_c", out_bound=ob)
+
+
+def fq12_sqr_lazy(a, in_bound=None):
+    bd, ob = plans.f12_interior()
+    bd = in_bound or bd
+    return plans.execute(plans.SQR12, a, a, bd, bd, "fq12_sqr_c", out_bound=ob)
+
+
+def fq12_cyclotomic_sqr_lazy(a, in_bound=None):
+    bd, ob = plans.f12_interior()
+    bd = in_bound or bd
+    return plans.execute(plans.CYC_SQR, a, a, bd, bd, "cyc_sqr_c", out_bound=ob)
+
+
+# --------------------------------------------------------------------------------------
+# Karabina compressed cyclotomic squaring
+# --------------------------------------------------------------------------------------
+#
+# In the Granger–Scott z-slot notation the cyclotomic square of the CYC_SQR
+# plan reads (with xi = u+1 and t2 = z2^2 + xi z3^2, t3 = 2 z2 z3,
+# t4 = z4^2 + xi z5^2, t5 = 2 z4 z5):
+#
+#   z2' = 6 xi z4 z5 + 2 z2        z3' = 3 (z4^2 + xi z5^2) - 2 z3
+#   z4' = 3 (z2^2 + xi z3^2) - 2 z4    z5' = 6 z2 z3 + 2 z5
+#
+# i.e. the (z2, z3, z4, z5) quadruple is closed under squaring — the
+# Karabina compression. A compressed element is [..., 8, 25] = [z2|z3|z4|z5];
+# compressed squaring is a 14-lane plan (4 sqr2 + 2 mul2) reducing 8 rows,
+# versus CYC_SQR's 18 lanes / 12 rows. Decompression recovers
+#
+#   z1 = (xi z5^2 + 3 z4^2 - 2 z3) / (4 z2)            [z2 != 0]
+#   z1 = (2 z4 z5) / z3                                [z2 == 0]
+#   z0 = (2 z1^2 + z2 z5 - 3 z3 z4) xi + 1
+#
+# with ONE fq2 inversion (inv0 semantics make the z2 == z3 == 0 identity
+# element fall out as z1 = 0, z0 = 1) — callers batch the decompression of
+# all bit-position collect points so the Fermat chain is paid once.
+
+# flat fq12 layout <-> z-slots (see CYC_SQR): coefficients
+# [z0(0:2) z4(2:4) z3(4:6) z2(6:8) z1(8:10) z5(10:12)].
+
+
+def fq12_compress(a):
+    """Cyclotomic fq12 [..., 12, 25] -> compressed [..., 8, 25] = [z2|z3|z4|z5]."""
+    return jnp.concatenate(
+        [a[..., 6:8, :], a[..., 4:6, :], a[..., 2:4, :], a[..., 10:12, :]],
+        axis=-2,
+    )
+
+
+def _build_karabina_sqr() -> plans.Plan:
+    from .plans import LC, v2_add, v2_nr
+
+    p = plans.Plan(8, 8)
+    x = plans.vbasis(8)
+    z2, z3, z4, z5 = x[0:2], x[2:4], x[4:6], x[6:8]
+    iz2 = [p.inp(0), p.inp(1)]
+    iz3 = [p.inp(2), p.inp(3)]
+    iz4 = [p.inp(4), p.inp(5)]
+    iz5 = [p.inp(6), p.inp(7)]
+    s2, s3, s4, s5 = p.sqr2(z2), p.sqr2(z3), p.sqr2(z4), p.sqr2(z5)
+    m45 = p.mul2(z4, z5)
+    m23 = p.mul2(z2, z3)
+    t2 = v2_add(s2, v2_nr(s3))
+    t4 = v2_add(s4, v2_nr(s5))
+
+    def scale(v, k):
+        return [c.scale(k) for c in v]
+
+    z2n = v2_add(scale(v2_nr(m45), 6), scale(iz2, 2))
+    z3n = [a.scale(3) - b.scale(2) for a, b in zip(t4, iz3)]
+    z4n = [a.scale(3) - b.scale(2) for a, b in zip(t2, iz4)]
+    z5n = v2_add(scale(m23, 6), scale(iz5, 2))
+    p.out_rows = z2n + z3n + z4n + z5n
+    return p
+
+
+KARABINA_SQR = _build_karabina_sqr()
+
+
+def fq12_compressed_sqr(c, in_bound=PUB_BOUND):
+    """One Karabina squaring on a compressed element [..., 8, 25]."""
+    return plans.execute(KARABINA_SQR, c, c, in_bound, in_bound, "kar_sqr")
+
+
+def fq12_compressed_sqr_lazy(c, in_bound=None):
+    bd, ob = plans.f12_interior()
+    bd = in_bound or bd
+    return plans.execute(KARABINA_SQR, c, c, bd, bd, "kar_sqr_c", out_bound=ob)
+
+
+def fq12_decompress(c):
+    """Compressed [..., 8, 25] (public-bounded) -> full cyclotomic fq12.
+    Branchless over the z2 == 0 special case; ONE fq2 inversion (the callers'
+    batch axis amortizes the Fermat chain)."""
+    z2, z3, z4, z5 = (
+        c[..., 0:2, :], c[..., 2:4, :], c[..., 4:6, :], c[..., 6:8, :]
+    )
+    s5, s4, m45, m35 = fq2_mul_many([(z5, z5), (z4, z4), (z4, z5), (z3, z4)])
+    z2_zero = t_is_zero(z2)
+    # numerator / denominator of z1 for both branches
+    num_a = plans.carry_norm(
+        t_sub(fq2_mul_by_nonresidue(s5) + s4 * np.uint64(3), z3 * np.uint64(2),
+              PUB_BOUND.scaled(2))
+    )
+    num_b = plans.carry_norm(m45 * np.uint64(2))
+    den_a = plans.carry_norm(z2 * np.uint64(4))
+    num = t_select(z2_zero, num_b, num_a)
+    den = t_select(z2_zero, z3, den_a)
+    z1 = fq2_mul(num, fq2_inv(den))
+    s1, m25 = fq2_mul_many([(z1, z1), (z2, z5)])
+    z0 = plans.carry_norm(
+        fq2_mul_by_nonresidue(
+            plans.carry_norm(
+                t_sub(s1 * np.uint64(2) + m25, m35 * np.uint64(3),
+                      PUB_BOUND.scaled(3))
+            )
+        )
+        + one(2, z1.shape[:-2])
+    )
+    return jnp.concatenate([z0, z4, z3, z2, z1, z5], axis=-2)
+
+
+def fq12_cyclotomic_exp_abs_x(a, compressed: "bool | None" = None):
+    """a^|x| (|x| = 0xd201000000010000, popcount 6), chain-plan compiled:
+    the exponent's schedule comes from ``chain_plans.compile_chains`` and
+    runs as ONE ``lax.scan`` of shared squaring runs with lazy fq12 interiors
+    (plans.F12_BOUND) — only the result pays the full PUB_BOUND walk.
+
+    ``compressed=True`` routes the squaring runs through the Karabina
+    compressed kernel: 63 compressed squarings collect the 6 bit-position
+    points, ONE batched decompression (a single fq2 Fermat chain for all 6)
+    recovers them, and a halving product tree combines. The Fermat chain is a
+    ~470-step scan, so compression can win only where the conv work (not the
+    step count) dominates — and on BOTH measurable CPU proxies it loses (f64:
+    direct unroll already 1.5x ahead; u64-digit: 300 ms compressed vs 183 ms
+    direct at the bench shape, the decompression chain dominating exactly as
+    the step-count model predicts). Until a ``platform: tpu`` record shows
+    the f32 conv path inverting that, compression is OPT-IN:
+    LIGHTHOUSE_PAIRING_KARABINA=1 flips the default."""
+    if compressed is None:
+        compressed = os.environ.get("LIGHTHOUSE_PAIRING_KARABINA") == "1"
+    if compressed:
+        return _cyc_exp_abs_x_compressed(a)
+    # direct trace-time unroll of the |x| segment schedule: each doubling
+    # run is one static-count fori_loop of the lazy cyclotomic square and
+    # the 5 set bits are unconditional multiplies — no table, no gathered
+    # operands, no masked multiply (the generic run_field_chains machinery
+    # measured ~20% slower here: |x| is binary-sparse, so its "table" is
+    # just the base and every gather/select is pure overhead)
     from .curve import fixed_schedule
 
     segs = fixed_schedule(-_of.BLS_X)
-    runs = jnp.asarray([r for r, _ in segs], dtype=jnp.int32)
-    muls = jnp.asarray([m for _, m in segs], dtype=jnp.int32)
-
-    def seg_body(res, seg):
-        run, mulf = seg
+    assert segs[0] == (1, 1)
+    res = fq12_mul_lazy(fq12_cyclotomic_sqr_lazy(a), a)
+    for run, mul in segs[1:]:
         res = jax.lax.fori_loop(
-            0, run, lambda _, g: fq12_cyclotomic_sqr(g), res
+            0, run, lambda _, g: fq12_cyclotomic_sqr_lazy(g), res
         )
-        return t_select(mulf == 1, fq12_mul(res, a), res), None
+        if mul:
+            res = fq12_mul_lazy(res, a)
+    return plans.carry_norm(res)
 
-    res, _ = jax.lax.scan(seg_body, a, (runs, muls))
-    return res
+
+_ABS_X_BITS = tuple(
+    i for i in range(64) if ((-_of.BLS_X) >> i) & 1
+)  # (16, 48, 57, 60, 62, 63)
+
+
+def _cyc_exp_abs_x_compressed(a):
+    """a^|x| via compressed squarings: a^|x| = prod_e a^(2^e) over the set
+    bits e of |x|; every a^(2^e) is a collect point of ONE compressed
+    squaring chain, decompressed as a single batch."""
+    c0 = fq12_compress(a)
+
+    def body(cc, _):
+        nxt = fq12_compressed_sqr_lazy(cc)
+        return nxt, nxt
+
+    _, states = jax.lax.scan(body, c0, None, length=max(_ABS_X_BITS))
+    collect = plans.carry_norm(
+        jnp.stack([states[e - 1] for e in _ABS_X_BITS], axis=0)
+    )
+    fs = fq12_decompress(collect)  # [6, ..., 12, 25]
+    n = fs.shape[0]
+    while n > 1:
+        if n % 2:
+            fs = jnp.concatenate(
+                [fs, one(12, (1,) + fs.shape[1:-2])], axis=0
+            )
+            n += 1
+        fs = fq12_mul(fs[: n // 2], fs[n // 2 :])
+        n //= 2
+    return fs[0]
 
 
 def fq12_is_one(a):

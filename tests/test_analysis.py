@@ -71,9 +71,14 @@ class TestCertifier:
             "lincomb_limb_budget",
         } <= kinds
 
-    def test_u64_walk_regime_certifies(self):
-        """Below fq.F64_WALK_MIN_ROWS the f64 backend statically dispatches
-        the u64 reduction walk — its own schedule, certified separately."""
+    def test_u64_walk_regime_certifies(self, monkeypatch):
+        """The u64 reduction walk is dead-by-default since
+        fq.F64_WALK_MIN_ROWS dropped to 0, but still invocable (the
+        threshold is a tunable) — force the threshold up so batch-1
+        dispatches it, and certify that schedule on its own."""
+        from lighthouse_tpu.ops.bls import fq
+
+        monkeypatch.setattr(fq, "F64_WALK_MIN_ROWS", 1 << 30)
         cert = bounds.certify(
             backends=("f64",),
             batches=(1,),

@@ -276,6 +276,172 @@ def test_findnode_per_request_response_tracking():
         b.stop()
 
 
+def test_spoofed_findnode_challenged_before_signature_work(monkeypatch):
+    """Stateless WHOAREYOU gate (ROADMAP discv5 hardening, the amplification
+    + forced-sig-verify surface): a FINDNODE carrying no valid source-address
+    cookie — what a source-spoofing attacker must send, since cookies only
+    ever reach the true owner of an address — is answered with a tiny
+    fixed-size WHOAREYOU challenge and costs the server ZERO ENR signature
+    verifications and ZERO NODES payload. Echoing the challenge cookie from
+    the true source then completes the exchange normally."""
+    import socket
+    import struct
+
+    from lighthouse_tpu.network import discovery as disc
+
+    fork = b"\x0d\x0d\x0d\x0d"
+    srv = DiscoveryService(fork_digest=fork).start()
+    peer = DiscoveryService(fork_digest=fork).start()
+    atk = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    atk.bind(("127.0.0.1", 0))
+    atk.settimeout(4.0)
+    try:
+        # seed the server's table so a successful FINDNODE WOULD carry a
+        # NODES record — the amplification payload the gate must withhold
+        peer.bootstrap(srv.enr)
+        assert _wait_for(lambda: len(srv.table) == 1)
+
+        verifies = []
+        orig_verify = ENR.verify
+        monkeypatch.setattr(
+            ENR, "verify", lambda self: verifies.append(1) or orig_verify(self)
+        )
+
+        # "spoofed" FINDNODE: a syntactically valid signed ENR (a real
+        # peer's record, replayed) sent from an address that never completed
+        # a challenge — exactly what an attacker forging the victim's source
+        # address can produce. No cookie (len 0), one distance.
+        d = log_distance(srv.enr.node_id, peer.enr.node_id)
+        inner = bytes([1]) + struct.pack(">H", d)
+        pkt = peer.enr.encode() + bytes([disc._FINDNODE]) + bytes([0]) + inner
+        atk.sendto(pkt, srv.enr.udp_addr)
+        data, _src = atk.recvfrom(65535)
+        _sender, off = ENR.decode(data)
+        assert data[off] == disc._WHOAREYOU, "expected a WHOAREYOU challenge"
+        cookie = data[off + 1 :]
+        assert len(cookie) == disc._COOKIE_LEN
+        # the challenge is bounded by the request size (no amplification
+        # toward a spoofed victim) and cost no signature verification
+        assert len(data) <= len(pkt) + disc._COOKIE_LEN
+        assert verifies == [], "spoofed FINDNODE triggered signature work"
+        # and no NODES ever follows the unanswered challenge
+        atk.settimeout(0.4)
+        try:
+            extra, _ = atk.recvfrom(65535)
+            _s, o = ENR.decode(extra)
+            raise AssertionError(f"unexpected packet kind {extra[o]}")
+        except socket.timeout:
+            pass
+
+        # true source: echo the cookie — the same request now yields NODES
+        atk.settimeout(4.0)
+        atk.sendto(
+            peer.enr.encode()
+            + bytes([disc._FINDNODE])
+            + bytes([disc._COOKIE_LEN])
+            + cookie
+            + inner,
+            srv.enr.udp_addr,
+        )
+        data, _src = atk.recvfrom(65535)
+        _sender, off = ENR.decode(data)
+        assert data[off] == disc._NODES
+        assert len(verifies) > 0, "cookie-carrying FINDNODE was not admitted"
+    finally:
+        atk.close()
+        srv.stop()
+        peer.stop()
+
+
+def test_unsolicited_nodes_dropped_before_signature_work(monkeypatch):
+    """A forged NODES packet from a node we have no FINDNODE outstanding to
+    must cost ZERO ENR signature verifications and teach nothing — otherwise
+    one spoofed datagram with 16 embedded ENRs buys up to 17 BLS verifies
+    (the forced-sig-verify cousin of the FINDNODE amplification)."""
+    import socket
+    import struct
+
+    fork = b"\x0d\x0d\x0d\x0d"
+    srv = DiscoveryService(fork_digest=fork).start()
+    peer = DiscoveryService(fork_digest=fork)  # never started: just an ENR
+    atk = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    atk.bind(("127.0.0.1", 0))
+    try:
+        verifies = []
+        orig_verify = ENR.verify
+        monkeypatch.setattr(
+            ENR, "verify", lambda self: verifies.append(1) or orig_verify(self)
+        )
+        from lighthouse_tpu.network import discovery as disc
+
+        body = struct.pack(">H", 1) + peer.enr.encode()
+        atk.sendto(
+            peer.enr.encode() + bytes([disc._NODES]) + body, srv.enr.udp_addr
+        )
+        assert not _wait_for(lambda: len(srv.table) > 0, timeout=1.0)
+        assert verifies == [], "unsolicited NODES triggered signature work"
+
+        # node_id alone must not open the gate: with a request outstanding
+        # to the (public, forgeable) node_id but NOT to the attacker's
+        # address, a spoofed NODES naming that id is still dropped and the
+        # waiter is NOT falsely settled
+        import threading
+
+        ev = threading.Event()
+        with srv._requests_lock:
+            srv._pending_requests[peer.enr.node_id] = [ev]
+            srv._pending_addrs[("198.51.100.7", 30303)] = 1
+        atk.sendto(
+            peer.enr.encode() + bytes([disc._NODES]) + body, srv.enr.udp_addr
+        )
+        assert not _wait_for(lambda: ev.is_set(), timeout=1.0), (
+            "spoofed node_id NODES falsely settled the waiter"
+        )
+        assert verifies == [] and len(srv.table) == 0
+    finally:
+        atk.close()
+        srv.stop()
+        peer.stop()
+
+
+def test_spoofed_whoareyou_single_retry_and_bounded_cache():
+    """Client side of the handshake: N WHOAREYOU challenges against one
+    outstanding FINDNODE yield exactly ONE resend and one cookie-cache write
+    (the in-flight body is consumed by the first — spoofed repeats are never
+    amplified), challenges with nothing outstanding are dropped, and the
+    cookie cache stays bounded under arbitrarily many challenger addresses."""
+    from lighthouse_tpu.network import discovery as disc
+
+    svc = DiscoveryService(fork_digest=b"\x0d\x0d\x0d\x0d")  # never started
+    try:
+        sent = []
+        svc._send = lambda addr, kind, body: sent.append((addr, kind, body))
+        addr = ("127.0.0.1", 12345)
+        cookie = b"\xab" * disc._COOKIE_LEN
+
+        # nothing outstanding -> dropped: no cache write, no traffic
+        svc._on_whoareyou(addr, cookie)
+        assert sent == [] and addr not in svc._cookies
+
+        inner = bytes([1, 0, 1])
+        svc._findnode_inflight[addr] = inner
+        svc._on_whoareyou(addr, cookie)
+        svc._on_whoareyou(addr, cookie)  # replayed/spoofed second challenge
+        assert len(sent) == 1, "spoofed WHOAREYOU repeat must not resend"
+        assert sent[0] == (
+            addr, disc._FINDNODE, bytes([disc._COOKIE_LEN]) + cookie + inner
+        )
+        assert svc._cookies[addr][0] == cookie
+
+        for i in range(2 * disc._COOKIE_CACHE_MAX):
+            a = ("10.0.0.1", i)
+            svc._findnode_inflight[a] = inner
+            svc._on_whoareyou(a, cookie)
+        assert len(svc._cookies) <= disc._COOKIE_CACHE_MAX
+    finally:
+        svc.stop()
+
+
 # ---------------------------------------------------------------------------
 # Transitive discovery: bootstrap from one node, find a third
 # ---------------------------------------------------------------------------

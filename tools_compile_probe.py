@@ -113,6 +113,17 @@ def main():
             jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
             jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
         )
+    if want("miller_product") and hasattr(pairing, "miller_loop_product"):
+        # the shared-accumulator batch-verify Miller loop (PR 6) — absent
+        # on pre-PR-6 trees, so before/after runs stay comparable
+        probe(
+            "miller_loop_product",
+            pairing.miller_loop_product,
+            jnp.ones((n + 1, 25), dtype=jnp.uint64),
+            jnp.ones((n + 1, 25), dtype=jnp.uint64),
+            jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
+            jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
+        )
     if want("finalexp"):
         probe(
             "fq12_prod+final_exp",

@@ -46,6 +46,7 @@ RECORD = os.path.join(CACHE, "tpu_record.json")
 RECORD_FIREHOSE = os.path.join(CACHE, "tpu_firehose_record.json")
 RECORD_EPOCH = os.path.join(CACHE, "tpu_epoch_record.json")
 RECORD_H2C = os.path.join(CACHE, "tpu_h2c_record.json")
+RECORD_PAIRING = os.path.join(CACHE, "tpu_pairing_record.json")
 RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
@@ -73,8 +74,10 @@ RUNGS.insert(
     + bench._FIREHOSE_RUNG[5:],
 )
 RUNGS.insert(2, bench._EPOCH_RUNG_SMALL)
-# h2c micro-rung (smallest program of the ladder — compile-warm via
-# .jax_cache): isolated hash-to-curve points/s + per-stage chain timings
+# h2c + pairing micro-rungs (smallest programs of the ladder — compile-warm
+# via .jax_cache): isolated hash-to-curve points/s and Miller/final-exp
+# pairing sets/s, each with per-stage chain timings and in-rung oracle parity
+RUNGS.insert(1, bench._PAIRING_RUNG_SMALL)
 RUNGS.insert(1, bench._H2C_RUNG_SMALL)
 RUNGS.append(bench._EPOCH_RUNG_FULL)
 
@@ -201,6 +204,7 @@ def persist(rec: dict, rung_idx: int) -> None:
         "firehose_attestations_verified_per_s": RECORD_FIREHOSE,
         "epoch_validators_per_s": RECORD_EPOCH,
         "h2c_points_per_s": RECORD_H2C,
+        "pairing_sets_per_s": RECORD_PAIRING,
     }.get(rec.get("metric"), RECORD)
     best = None
     try:
