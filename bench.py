@@ -516,6 +516,29 @@ def _enable_compile_cache():
     import lighthouse_tpu  # noqa: F401
 
 
+def _resilience_summary() -> dict | None:
+    """Fault-domain integrity stamp for every rung record (ISSUE 7): the
+    supervisor snapshot proves whether any part of the measurement was
+    served below the full device rung — a demoted / CPU-fallback run can
+    never masquerade as a device-throughput record in BENCH_*.json."""
+    try:
+        from lighthouse_tpu.resilience import snapshot_all
+    except Exception:  # noqa: BLE001 — the stamp must never fail a record
+        return None
+    snaps = snapshot_all()
+    demotions = sum(s["demotions"] for s in snaps.values())
+    fallback = sum(s["fallback_calls"] for s in snaps.values())
+    return {
+        "demotions": demotions,
+        "fallback_calls": fallback,
+        "watchdog_timeouts": sum(
+            s["watchdog_timeouts"] for s in snaps.values()
+        ),
+        "degraded": bool(demotions or fallback),
+        "supervisor_states": {k: v["state"] for k, v in snaps.items()},
+    }
+
+
 def _inner():
     """Run the full native + device measurement at the env-given shapes and
     print the JSON record. Invoked in a SUBPROCESS by main() so a wedged or
@@ -560,6 +583,7 @@ def _inner():
                 "stages_ms_per_batch": stages,
                 "kernel_gflops_per_batch": round(flops / 1e9, 2) if flops else None,
                 "mfu_estimate": mfu,
+                "resilience": _resilience_summary(),
             }
         )
     )
@@ -613,6 +637,12 @@ def _inner_firehose():
         flush=True,
     )
 
+    # the rung runs inside its own fault domain: watchdog + retry + the
+    # full->halved ladder (no CPU-fallback rung — a demoted stream must
+    # show up as errored/demoted in the record, not as fake throughput)
+    from lighthouse_tpu.resilience import get_supervisor
+
+    supervisor = get_supervisor("bench.firehose")
     engine = FirehoseEngine(
         prepare_fn=lambda payloads: [([p], None) for p in payloads],
         verify_items_fn=verify,
@@ -621,6 +651,7 @@ def _inner_firehose():
             deadline_s=0.010,
             intake_capacity=intake,
         ),
+        supervisor=supervisor,
     )
     # paced submission: `rate` att/s in 1 ms micro-bursts (the intake is
     # non-blocking; overflow sheds inside the engine, never stalls us)
@@ -666,6 +697,8 @@ def _inner_firehose():
                 "dropped": st.dropped,
                 "drop_rate": round(drop_rate, 4),
                 "batches_formed": st.batches_formed,
+                "device_faults": st.device_faults,
+                "resilience": _resilience_summary(),
                 "queue_latency_p50_ms": (
                     round(st.p50_latency_s * 1e3, 2)
                     if st.p50_latency_s is not None
@@ -754,6 +787,7 @@ def _inner_h2c():
                 "stages_ms_per_batch": {
                     k: round(v, 2) for k, v in stages.items()
                 },
+                "resilience": _resilience_summary(),
             }
         )
     )
@@ -845,6 +879,7 @@ def _inner_pairing():
                 "stages_ms_per_batch": {
                     k: round(v, 2) for k, v in stages.items()
                 },
+                "resilience": _resilience_summary(),
             }
         )
     )
@@ -1001,6 +1036,7 @@ def _inner_epoch():
                     stats.get("last_host_to_device_bytes")
                 ),
                 "mirror": stats,
+                "resilience": _resilience_summary(),
             }
         )
     )

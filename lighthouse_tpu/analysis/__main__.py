@@ -22,6 +22,11 @@ def main(argv=None) -> int:
     ap.add_argument("--bounds", action="store_true", help="run only the limb-bound certifier")
     ap.add_argument("--lint", action="store_true", help="run only the trace-hygiene linter")
     ap.add_argument(
+        "--supervisor", action="store_true",
+        help="run only the supervisor-transparency probe (lint-clean "
+        "resilience wrappers + zero steady-state recompiles)",
+    )
+    ap.add_argument(
         "--cert-out",
         default=None,
         help="write BOUNDS_CERT.json here (default: repo root when the bounds"
@@ -36,11 +41,31 @@ def main(argv=None) -> int:
         help="batch regimes to certify (default 1 32)",
     )
     args = ap.parse_args(argv)
-    run_bounds = args.bounds or not args.lint
-    run_lint = args.lint or not args.bounds
+    any_selected = args.bounds or args.lint or args.supervisor
+    run_bounds = args.bounds or not any_selected
+    run_lint = args.lint or not any_selected
+    run_supervisor = args.supervisor or not any_selected
 
     report: dict = {"ok": True}
     rc = 0
+
+    if run_supervisor:
+        from .supervised import supervisor_probe
+
+        sup_rep = supervisor_probe()
+        report["supervisor"] = sup_rep
+        if not sup_rep["ok"]:
+            report["ok"] = False
+            rc = 1
+        if not args.json:
+            print(
+                "supervisor: "
+                f"{len(sup_rep['lint_findings'])} lint finding(s), "
+                f"{len(sup_rep['steady_state_compiles'])} steady-state "
+                f"recompile(s), transparent={sup_rep['transparent']} — "
+                f"{'ok' if sup_rep['ok'] else 'FAIL'}",
+                file=sys.stderr,
+            )
 
     if run_lint:
         from .hygiene import lint_tree

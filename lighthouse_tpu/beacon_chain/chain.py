@@ -145,6 +145,9 @@ class BeaconChain:
         ]
         self._states: dict[bytes, object] = {genesis_root: genesis_state}
         self._blocks: dict[bytes, object] = {}
+        # bounded FIFO of store-decoded frozen blocks (get_signed_block)
+        self._cold_block_cache: dict[bytes, object] = {}
+        self._COLD_BLOCK_CACHE_MAX = 512
         self.head = ChainHead(
             root=genesis_root, slot=genesis_state.slot, state=genesis_state
         )
@@ -286,18 +289,7 @@ class BeaconChain:
         """Reload a frozen/persisted state by block root (hot bytes, else
         the cold hierarchy; replay-layer slots reconstruct the nearest
         stored anchor and replay stored canonical blocks)."""
-        raw = self.store.get_block(block_root)
-        if raw is None:
-            return None
-        # the block's slot identifies the fork for decoding
-        blk_cls = None
-        for fork in reversed(list(self.ns.block_types)):
-            try:
-                blk_cls = self.ns.block_types[fork]
-                signed = blk_cls.decode(raw)
-                break
-            except Exception:
-                signed = None
+        signed = self.get_signed_block(block_root)
         if signed is None:
             return None
         state_root = bytes(signed.message.state_root)
@@ -508,6 +500,43 @@ class BeaconChain:
             self.genesis_block_root not in self._blocks
             and self._oldest_block_slot > 0
         )
+
+    def get_signed_block(self, block_root: bytes):
+        """Decoded SignedBeaconBlock by root: the in-memory hot map first,
+        else the persistent store. The finalization migration drops the
+        decoded copies of frozen canonical blocks from ``_blocks`` (bounding
+        memory), which used to truncate ``blocks_by_range`` serving at the
+        finalized horizon — a from-genesis peer could then NEVER range-sync
+        past our finalized epoch (every served segment started with an
+        unknown parent). Req/Resp serving must read through to the store.
+
+        Store-decoded blocks are kept in a small bounded FIFO cache: a
+        range-sync serving a long history walks the same frozen parents
+        once per BlocksByRange request, and re-decoding them per request
+        would make segment serving quadratic in chain length. The cache is
+        separate from ``_blocks`` so the finalization migration's memory
+        bound still holds."""
+        sb = self._blocks.get(block_root)
+        if sb is not None:
+            return sb
+        sb = self._cold_block_cache.get(block_root)
+        if sb is not None:
+            return sb
+        raw = self.store.get_block(block_root)
+        if raw is None:
+            return None
+        for fork in reversed(list(self.ns.block_types)):
+            try:
+                sb = self.ns.block_types[fork].decode(raw)
+            except Exception:  # noqa: BLE001 — wrong fork schema: keep trying
+                continue
+            while len(self._cold_block_cache) >= self._COLD_BLOCK_CACHE_MAX:
+                self._cold_block_cache.pop(
+                    next(iter(self._cold_block_cache))
+                )
+            self._cold_block_cache[block_root] = sb
+            return sb
+        return None
 
     def import_anchor_block(self, signed_block) -> None:
         """Accept the checkpoint anchor block itself. No signature check
@@ -760,18 +789,69 @@ class BeaconChain:
         RLC batch. On the tpu backend this is the fully-fused device path:
         cache gather + device h2c + device signature decompression, zero
         per-batch oracle-point conversion. Other backends go through the
-        generic SignatureSet seam."""
+        generic SignatureSet seam.
+
+        Every backend call runs inside the ``bls_device`` fault domain
+        (resilience.supervisor): watchdog deadline, bounded transient
+        retries, and the degradation ladder full device shape -> halved
+        batch shape -> pure-Python oracle. A batch whose every rung faults
+        fails CLOSED (False -> bisection -> per-group rejection): work may
+        be dropped and counted, but nothing is ever falsely verified."""
         if not items:
             return False
+        from ..resilience import SupervisedFault
+
         with ATTESTATION_BATCH_VERIFY_TIMES.time():
-            return self._batch_verify_items_inner(items)
+            try:
+                return self._batch_verify_items_inner(items)
+            except SupervisedFault:
+                return False  # every rung faulted (recorded): fail closed
 
     def _batch_verify_items_inner(self, items) -> bool:
+        from ..resilience import bls_supervisor
+
+        sup = bls_supervisor()
         if bls.get_backend() == "tpu":
             from ..bls import tpu_backend as tb
 
             cache = self.pubkey_cache.device_array()
-            return tb.verify_indexed_sets_device(cache, items)
+
+            def full():
+                return tb.verify_indexed_sets_device(cache, items)
+
+            def reduced():
+                # halved n-bucket: the OOM rung — everything still verifies,
+                # in two smaller fixed-shape dispatches
+                mid = (len(items) + 1) // 2
+                if mid == len(items):
+                    return tb.verify_indexed_sets_device(cache, items)
+                return tb.verify_indexed_sets_device(
+                    cache, items[:mid]
+                ) and tb.verify_indexed_sets_device(cache, items[mid:])
+
+            return sup.run_ladder(
+                "bls.batch_verify",
+                (
+                    ("device_full", full),
+                    ("device_reduced", reduced),
+                    ("cpu_oracle", lambda: self._verify_items_via_sets(
+                        items, oracle=True
+                    )),
+                ),
+            )
+        return sup.run_ladder(
+            "bls.batch_verify",
+            (
+                ("primary", lambda: self._verify_items_via_sets(items)),
+                ("cpu_oracle", lambda: self._verify_items_via_sets(
+                    items, oracle=True
+                )),
+            ),
+        )
+
+    def _verify_items_via_sets(self, items, oracle: bool = False) -> bool:
+        """The generic SignatureSet path for item triples; ``oracle=True``
+        pins the pure-Python oracle (the ladder's device-free last rung)."""
         sets = []
         for indices, msg, sig_bytes in items:
             try:
@@ -785,6 +865,8 @@ class BeaconChain:
                 )
             except bls.BlsError:
                 return False
+        if oracle:
+            return bls.verify_signature_sets_oracle(sets)
         return bls.verify_signature_sets(sets)
 
     def _attester_item(self, state, indexed):
@@ -974,7 +1056,13 @@ class BeaconChain:
         (firehose/engine.py). Handles BOTH firehose-eligible payload kinds:
         unaggregated Attestations (one set) and SignedAggregateAndProofs
         (three sets); verdicts apply to fork choice / the naive pool
-        exactly like the verify_* batch paths."""
+        exactly like the verify_* batch paths.
+
+        Fault-domain note: the verify stage IS ``_batch_verify_items``,
+        which already runs inside the ``bls_device`` supervisor (watchdog,
+        retries, degradation ladder down to the pure-Python oracle) — the
+        engine is deliberately built WITHOUT its own supervisor so device
+        calls are never double-wrapped."""
         from ..firehose import FirehoseEngine
 
         def prepare(payloads):

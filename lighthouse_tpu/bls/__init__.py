@@ -277,6 +277,13 @@ def verify_signature_sets(sets) -> bool:
     return _verify_sets_tpu(sets)
 
 
+def verify_signature_sets_oracle(sets) -> bool:
+    """Batch verification pinned to the pure-Python oracle regardless of the
+    active backend — the degradation ladder's CPU rung of last resort
+    (resilience.supervisor): always available, trusted, device-free."""
+    return _verify_sets_oracle(list(sets))
+
+
 def warmup(n_sets: int = 2) -> bool:
     """Pre-compile the active backend's verification kernels.
 

@@ -70,7 +70,15 @@ def device_backend_active() -> bool:
 
 def maybe_process_epoch_on_device(spec, state) -> bool:
     """The ``process_epoch`` seam: True when the device engine fully handled
-    the epoch transition, False when the numpy path should run."""
+    the epoch transition, False when the numpy path should run.
+
+    The device engine runs inside the ``epoch_device`` fault domain
+    (resilience.supervisor): a faulted or quarantined sweep returns False
+    with the state untouched, so the numpy twin owns that boundary —
+    demotion, never a crashed slot. Exceptions from the *write-back* phase
+    deliberately propagate: by then the state is partially mutated, and
+    demoting to a second full numpy transition would apply the epoch twice
+    (silent consensus corruption is strictly worse than a loud crash)."""
     if not device_backend_active():
         return False
     from .engine import process_epoch_on_device

@@ -57,14 +57,17 @@ def prepare_state(state, sharding=None) -> RegistryMirror | None:
     return m
 
 
-def process_epoch_on_device(spec, state, sharding=None) -> bool:
-    """Run one epoch transition through the device engine. Returns False
-    (state untouched) when the state's fork family is not kernelized."""
-    fork = getattr(state, "fork_name", "phase0")
-    if not supported_fork(fork):
-        return False
+def _device_sweep(spec, state, sharding):
+    """The DEVICE region of one epoch boundary: mirror bind/sync, column
+    upload, the fused sweep, and full materialization of its outputs back
+    to host numpy. No ``state`` mutation happens in here — materializing
+    inside the supervised region means an async device fault surfaces
+    *before* any host-side write-back, so a faulted boundary leaves the
+    state byte-identical and the numpy path can take over (demotion
+    parity)."""
     from ..state_transition.beacon_state_util import get_current_epoch
 
+    fork = getattr(state, "fork_name", "phase0")
     mirror = mirror_of(state, create=True, sharding=sharding)
     mirror.sync(state)
 
@@ -104,6 +107,43 @@ def process_epoch_on_device(spec, state, sharding=None) -> bool:
     }
 
     outs = run_sweep(consts, cols, scalars)
+    # force completion (keeping outputs device-resident for the mirror):
+    # a deferred device error must fault HERE, inside the supervised
+    # region, not during state write-back
+    for v in outs.values():
+        ready = getattr(v, "block_until_ready", None)
+        if ready is not None:
+            ready()
+    return mirror, outs
+
+
+def process_epoch_on_device(spec, state, sharding=None) -> bool:
+    """Run one epoch transition through the device engine. Returns False
+    (state untouched) when the state's fork family is not kernelized, when
+    the ``epoch_device`` fault domain has the backend quarantined, or when
+    the sweep faults — the numpy path then handles this boundary (the
+    degradation ladder's device -> numpy demotion), and the supervisor's
+    probation logic re-promotes the device backend later."""
+    fork = getattr(state, "fork_name", "phase0")
+    if not supported_fork(fork):
+        return False
+    from ..resilience import SupervisedFault, epoch_supervisor
+
+    sup = epoch_supervisor()
+    if not sup.device_allowed():
+        sup.note_fallback(rung="numpy")
+        return False
+    try:
+        mirror, outs = sup.run(
+            "epoch.sweep", lambda: _device_sweep(spec, state, sharding)
+        )
+    except SupervisedFault:
+        # device state is indeterminate: drop the mirror so a later attempt
+        # re-binds from scratch, and let the numpy path own this boundary
+        if getattr(state, _MIRROR_ATTR, None) is not None:
+            object.__delattr__(state, _MIRROR_ATTR)
+        sup.note_fallback(rung="numpy")
+        return False
 
     _apply_justification(spec, state, outs)
     n = mirror.n

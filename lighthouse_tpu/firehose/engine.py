@@ -29,6 +29,7 @@ import time
 from dataclasses import dataclass
 
 from ..beacon_processor.processor import WorkType
+from ..resilience import faults
 from ..utils.metrics import (
     FIREHOSE_BATCH_FILL,
     FIREHOSE_BATCHES_FORMED,
@@ -51,6 +52,7 @@ class FirehoseStats:
     batches_formed: int
     p50_latency_s: float | None
     p99_latency_s: float | None
+    device_faults: int = 0
 
     def as_dict(self) -> dict:
         return {
@@ -62,6 +64,7 @@ class FirehoseStats:
             "batches_formed": self.batches_formed,
             "p50_latency_s": self.p50_latency_s,
             "p99_latency_s": self.p99_latency_s,
+            "device_faults": self.device_faults,
         }
 
 
@@ -88,11 +91,18 @@ class FirehoseEngine:
         verify_items_fn,
         config: FirehoseConfig | None = None,
         synchronous: bool = False,
+        supervisor=None,
+        fallback_verify_fn=None,
     ):
         self.config = config or FirehoseConfig()
         self.batcher = AdaptiveBatcher(self.config)
         self.prepare_fn = prepare_fn
         self.verify_items_fn = verify_items_fn
+        # optional fault domain (resilience.BackendSupervisor): device calls
+        # run down the degradation ladder full -> halved -> fallback_verify_fn
+        # with watchdog + classified retries instead of failing the batch
+        self.supervisor = supervisor
+        self.fallback_verify_fn = fallback_verify_fn
         self.synchronous = synchronous
         # callback(payload, ok, meta) used when submit() gives none
         self.default_callback = None
@@ -100,11 +110,13 @@ class FirehoseEngine:
         self.rejected = 0          # bad signature (bisection-condemned)
         self.errored = 0           # prep-stage rejections
         self.batches_formed = 0
+        self.device_faults = 0     # batches that lost their device verdict
         self._latencies: list[float] = []
         self._stats_lock = threading.Lock()
         self._prepared: queue.Queue = queue.Queue(maxsize=self.config.prep_depth)
         self._threads: list[threading.Thread] = []
         self._stopping = False
+        self._aborted = False      # stop() gave up on a wedged thread
         if not synchronous:
             for name, target in (
                 ("firehose-prep", self._prep_loop),
@@ -137,6 +149,29 @@ class FirehoseEngine:
         groups = self.prepare_fn([it.payload for it in batch])
         return batch, groups
 
+    def _supervised_verify(self, items) -> bool:
+        """The device verify call, run through the fault domain when one is
+        attached: full shape -> halved shapes -> CPU fallback, with watchdog
+        + bounded transient retries. A ``False`` verdict is a result (it
+        triggers bisection), never a fault."""
+        if self.supervisor is None:
+            return self.verify_items_fn(items)
+        rungs = [("device_full", lambda: self.verify_items_fn(items))]
+        if len(items) > 1:
+            mid = (len(items) + 1) // 2
+
+            def reduced():
+                return self.verify_items_fn(items[:mid]) and self.verify_items_fn(
+                    items[mid:]
+                )
+
+            rungs.append(("device_reduced", reduced))
+        if self.fallback_verify_fn is not None:
+            rungs.append(
+                ("cpu_fallback", lambda: self.fallback_verify_fn(items))
+            )
+        return self.supervisor.run_ladder("firehose.device_verify", rungs)
+
     def _verify_batch(self, prepped) -> None:
         """Device stage: batched verify, bisection on failure, callbacks."""
         batch, entries = prepped
@@ -151,23 +186,29 @@ class FirehoseEngine:
         device_failed = False
         if real:
             # a device fault must not strand the batch without verdicts:
-            # every item still gets its callback, counted as errored
+            # every item still gets its callback, counted as errored —
+            # and the fault is classified + recorded, never dropped silently
             try:
                 flat = [item for _, group, _ in real for item in group]
-                if self.verify_items_fn(flat):
+                if self._supervised_verify(flat):
                     for i, _ in enumerate(real):
                         verdicts[i] = True
                 else:
                     for i, ok in enumerate(
                         bisect_verify(
                             [group for _, group, _ in real],
-                            self.verify_items_fn,
+                            self._supervised_verify,
                             assume_failed=True,
                         )
                     ):
                         verdicts[i] = ok
-            except Exception:  # noqa: BLE001 — device fault fails the batch
+            except Exception as e:  # noqa: BLE001 — device fault fails the batch
                 device_failed = True
+                faults.record_fault(
+                    "firehose.verify_batch", e, domain="firehose"
+                )
+                with self._stats_lock:
+                    self.device_faults += 1
                 for i, _ in enumerate(real):
                     verdicts[i] = False
         now = time.monotonic()
@@ -214,28 +255,50 @@ class FirehoseEngine:
 
     # -- threaded pipeline --------------------------------------------------------
 
+    def _handoff(self, prepped) -> bool:
+        """Abort-aware put onto the bounded prep->device queue: blocks at
+        prep_depth for back-pressure, but stays cancellable so a wedged
+        device thread can never pin the prep thread past ``stop()``."""
+        while True:
+            try:
+                self._prepared.put(prepped, timeout=0.2)
+                return True
+            except queue.Full:
+                if self._aborted:
+                    return False
+
     def _prep_loop(self) -> None:
         while True:
             batch = self.batcher.next_batch()
             if batch is None:          # batcher closed and drained
-                self._prepared.put(None)
+                self._handoff(None)
                 return
             try:
                 prepped = self._prep_batch(batch)
             except Exception as e:  # noqa: BLE001 — poison batch, keep pumping
+                # classified fault record instead of a silent poison
+                faults.record_fault("firehose.prep", e, domain="firehose")
                 prepped = (batch, [e] * len(batch))
-            self._prepared.put(prepped)  # blocks at prep_depth: double buffer
+            if not self._handoff(prepped):  # blocks at prep_depth: double buffer
+                return
 
     def _device_loop(self) -> None:
         while True:
-            prepped = self._prepared.get()
+            try:
+                prepped = self._prepared.get(timeout=0.2)
+            except queue.Empty:
+                if self._aborted:
+                    return
+                continue
             if prepped is None:
                 return
             try:
                 self._verify_batch(prepped)
-            except Exception:  # noqa: BLE001 — a device fault drops one batch
+            except Exception as e:  # noqa: BLE001 — a device fault drops one batch
+                faults.record_fault("firehose.device_loop", e, domain="firehose")
                 with self._stats_lock:
                     self.errored += len(prepped[0])
+                    self.device_faults += 1
 
     # -- synchronous mode / shutdown ---------------------------------------------
 
@@ -252,10 +315,11 @@ class FirehoseEngine:
 
     def flush(self, timeout: float = 30.0) -> bool:
         """Block until everything ACCEPTED so far has a verdict or was
-        evicted (or the timeout expires). Threaded mode only. Gate-rejected
-        submissions never enter ``submitted``, so only post-accept
-        evictions count against it — a batch mid-verify keeps this False
-        until its verdicts land."""
+        evicted (or the timeout expires — a hard deadline: a wedged device
+        call is recorded as a classified hang fault, never waited out).
+        Threaded mode only. Gate-rejected submissions never enter
+        ``submitted``, so only post-accept evictions count against it — a
+        batch mid-verify keeps this False until its verdicts land."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._stats_lock:
@@ -263,17 +327,49 @@ class FirehoseEngine:
             if settled + self.batcher.evicted >= self.batcher.submitted:
                 return True
             time.sleep(0.005)
+        faults.record_fault(
+            "firehose.flush",
+            f"flush timeout: verdicts still outstanding after {timeout:.1f}s",
+            kind=faults.FaultKind.HANG,
+            domain="firehose",
+        )
         return False
 
-    def stop(self, drain_timeout: float = 30.0) -> None:
+    def stop(self, drain_timeout: float = 30.0) -> bool:
+        """Drain + shut down the pipeline. ``drain_timeout`` is a HARD
+        deadline across both threads: a device call wedged inside the
+        backend cannot block shutdown forever — the wedge is recorded as a
+        classified hang fault, the handoff queue is aborted so the prep
+        thread exits, and the stranded daemon thread is abandoned. Returns
+        True on a clean drain, False when a thread had to be abandoned."""
         if self.synchronous:
             self.drain()
-            return
+            return True
         if not self._stopping:
             self._stopping = True
             self.batcher.close()
+        deadline = time.monotonic() + drain_timeout
         for th in self._threads:
-            th.join(timeout=drain_timeout)
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        alive = [th.name for th in self._threads if th.is_alive()]
+        if not alive:
+            return True
+        faults.record_fault(
+            "firehose.shutdown",
+            f"threads {alive} still alive after the {drain_timeout:.1f}s "
+            "drain deadline (wedged device call?)",
+            kind=faults.FaultKind.HANG,
+            domain="firehose",
+        )
+        self._aborted = True
+        try:  # unwedge a prep thread blocked on the handoff queue
+            while True:
+                self._prepared.get_nowait()
+        except queue.Empty:
+            pass
+        for th in self._threads:
+            th.join(timeout=0.5)
+        return False
 
     # -- reporting ----------------------------------------------------------------
 
@@ -299,4 +395,9 @@ class FirehoseEngine:
                 batches_formed=self.batches_formed,
                 p50_latency_s=self._percentile(lats, 0.50),
                 p99_latency_s=self._percentile(lats, 0.99),
+                device_faults=self.device_faults,
             )
+
+    def resilience(self) -> dict | None:
+        """Attached fault-domain snapshot (None without a supervisor)."""
+        return None if self.supervisor is None else self.supervisor.snapshot()

@@ -225,11 +225,13 @@ class BeaconNodeService:
 
     def blocks_by_range(self, start_slot: int, count: int) -> list:
         """Canonical-chain blocks in [start_slot, start_slot+count)
-        (rpc_methods.rs BlocksByRange)."""
+        (rpc_methods.rs BlocksByRange). Reads through to the persistent
+        store (``chain.get_signed_block``) so serving keeps working below
+        the finalized horizon, where the in-memory map is pruned."""
         out = []
         root = self.chain.head.root
         while root is not None:
-            sb = self.chain._blocks.get(root)
+            sb = self.chain.get_signed_block(root)
             if sb is None:
                 break
             s = int(sb.message.slot)
@@ -242,6 +244,5 @@ class BeaconNodeService:
         return out
 
     def blocks_by_root(self, roots) -> list:
-        return [
-            self.chain._blocks[r] for r in roots if r in self.chain._blocks
-        ]
+        blocks = (self.chain.get_signed_block(r) for r in roots)
+        return [sb for sb in blocks if sb is not None]

@@ -53,23 +53,44 @@ class LoopbackTransport(Transport):
     """All nodes in one process; delivery is an immediate method call.
 
     Fault injection: ``partition(a, b)`` drops traffic between two peers
-    (both gossip and RPC) until ``heal()``.
+    (both gossip and RPC) until ``heal()``; ``set_gossip_loss(rate, seed)``
+    drops each gossip delivery with a SEEDED probability — deterministic
+    given the seed and the (synchronous) publish order, so a chaos run
+    replays exactly; ``unregister`` simulates a node crash (the chaos
+    harness re-``register``s on restart).
     """
 
     def __init__(self):
         self._handlers: dict[str, object] = {}  # peer_id -> service
         self._partitions: set[frozenset] = set()
+        self._loss_rate = 0.0
+        self._loss_rng = None
+        self.gossip_delivered = 0
+        self.gossip_dropped = 0  # seeded-loss drops only (not partitions)
 
     def register(self, peer_id: str, service) -> None:
         if peer_id in self._handlers:
             raise ValueError(f"duplicate peer id {peer_id}")
         self._handlers[peer_id] = service
 
+    def unregister(self, peer_id: str) -> None:
+        """Crash ``peer_id``: all delivery to/from it stops until a new
+        service registers under the same id."""
+        self._handlers.pop(peer_id, None)
+
     def partition(self, a: str, b: str) -> None:
         self._partitions.add(frozenset((a, b)))
 
     def heal(self) -> None:
         self._partitions.clear()
+
+    def set_gossip_loss(self, rate: float, seed: int = 0) -> None:
+        """Drop each (recipient, message) gossip delivery with probability
+        ``rate``, decided by a dedicated seeded RNG. ``rate=0`` disables."""
+        import random as _random
+
+        self._loss_rate = float(rate)
+        self._loss_rng = _random.Random(seed) if rate > 0 else None
 
     def _blocked(self, a: str, b: str) -> bool:
         return frozenset((a, b)) in self._partitions
@@ -78,6 +99,12 @@ class LoopbackTransport(Transport):
         for pid, svc in list(self._handlers.items()):
             if pid == from_peer or self._blocked(pid, from_peer):
                 continue
+            if self._loss_rng is not None and (
+                self._loss_rng.random() < self._loss_rate
+            ):
+                self.gossip_dropped += 1
+                continue
+            self.gossip_delivered += 1
             svc.on_gossip(topic, message, from_peer)
 
     def request(self, from_peer: str, to_peer: str, method: str, payload):

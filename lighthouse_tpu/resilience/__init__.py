@@ -1,0 +1,82 @@
+"""Fault-domain layer between the serving engines and the device backends.
+
+Three pieces (ISSUE 7):
+
+* ``faults``     — the fault taxonomy (transient / oom / hang / corruption),
+  classifier, and the process-global classified-fault ring that replaces
+  every silent ``except Exception`` drop on the device path.
+* ``supervisor`` — per-domain backend supervisors: watchdog deadlines for
+  hang detection, bounded jittered-backoff retry for transients, and a
+  HEALTHY → DEGRADED → QUARANTINED circuit breaker driving a degradation
+  ladder (full device shape → reduced batch shape → native/oracle CPU
+  fallback) so a device fault degrades throughput instead of dropping work.
+* ``inject``     — the seeded, env-gated deterministic fault injector
+  (``LIGHTHOUSE_FAULT_INJECT``) that the chaos harness uses to make any
+  supervised stage raise, hang, or corrupt on the Nth call.
+
+Import-light: no jax anywhere in this package — supervisors wrap device
+calls, they never trace into them, so the jit-facing call boundary is
+byte-identical (the analysis CLI's supervisor pass proves zero added
+steady-state recompiles).
+
+Canonical fault domains:
+
+* ``bls_supervisor()``   — the batched BLS verify path
+  (``beacon_chain.chain._batch_verify_items`` and through it the firehose).
+* ``epoch_supervisor()`` — the device epoch engine
+  (``epoch_engine.engine.process_epoch_on_device``).
+"""
+
+from __future__ import annotations
+
+from .faults import (  # noqa: F401
+    FaultKind,
+    FaultRecord,
+    SupervisedFault,
+    WatchdogTimeout,
+    classify,
+    classify_text,
+    clear_fault_log,
+    recent_faults,
+    record_fault,
+)
+from .inject import (  # noqa: F401
+    ENV_VAR as INJECT_ENV_VAR,
+    FaultInjector,
+    InjectedFault,
+    injector,
+    maybe_fault,
+)
+from .supervisor import (  # noqa: F401
+    BackendSupervisor,
+    HealthState,
+    SupervisorConfig,
+    all_supervisors,
+    get_supervisor,
+    reset_all,
+    run_with_deadline,
+    snapshot_all,
+)
+
+BLS_DOMAIN = "bls_device"
+EPOCH_DOMAIN = "epoch_device"
+
+
+def bls_supervisor() -> BackendSupervisor:
+    """The fault domain guarding batched BLS device verification."""
+    return get_supervisor(BLS_DOMAIN)
+
+
+def epoch_supervisor() -> BackendSupervisor:
+    """The fault domain guarding the device epoch engine."""
+    return get_supervisor(EPOCH_DOMAIN)
+
+
+def health_snapshot() -> dict:
+    """Fault-domain health for /health + monitoring: per-domain supervisor
+    snapshots plus the most recent classified faults."""
+    return {
+        "supervisors": snapshot_all(),
+        "recent_faults": recent_faults(16),
+        "injection_active": injector.active(),
+    }

@@ -5,6 +5,13 @@ validators are partitioned across nodes, every slot the owning node proposes
 and publishes the block over gossip, every node's validators attest over
 gossip (feeding each node's op pool through the batched verification path),
 and the checks assert finalization advances on ALL nodes.
+
+Chaos harness (ISSUE 7): ``crash_node``/``restart_node`` plus the loopback
+transport's seeded gossip loss and the ``LIGHTHOUSE_FAULT_INJECT`` device
+fault injector make a deterministic multi-node churn scenario —
+``tests/test_resilience.py`` runs N slots under injected device faults,
+dropped gossip, and a node crash/restart, asserting liveness, zero
+false-verifies, and the drop-rate SLO.
 """
 
 from __future__ import annotations
@@ -33,6 +40,9 @@ class LocalNetwork:
         assert n_validators % n_nodes == 0
         self.spec = spec
         self.mode = transport
+        self.dead: set[int] = set()   # crashed node indices (chaos harness)
+        self.missed_proposals = 0     # invalid-on-own-chain proposals skipped
+        self._chaos_seen = False      # any crash/loss ever armed this run
         self.clock = ManualSlotClock(0)
         # one harness supplies genesis + deterministic keys; each node only
         # "owns" (signs with) its shard of the validator set
@@ -128,21 +138,92 @@ class LocalNetwork:
                 self.boot.stop()
 
     def _owner_of(self, validator_index: int) -> BeaconNodeService:
-        for node, rng in zip(self.nodes, self.owned):
+        return self.nodes[self._owner_index(validator_index)]
+
+    def _owner_index(self, validator_index: int) -> int:
+        for i, rng in enumerate(self.owned):
             if validator_index in rng:
-                return node
+                return i
         raise ValueError(validator_index)
+
+    def _chaos_active(self) -> bool:
+        """Has any chaos mechanism ever been armed (crash or gossip loss)?
+        Non-chaos runs keep strict semantics: a proposal its own node
+        rejects is a test failure, never a silently missed slot."""
+        # sockets mode has per-node transports and no shared self.transport
+        shared = getattr(self, "transport", None)
+        return (
+            self._chaos_seen
+            or bool(self.dead)
+            or getattr(shared, "_loss_rate", 0.0) > 0
+        )
+
+    def _alive_ref(self) -> BeaconNodeService:
+        for i, node in enumerate(self.nodes):
+            if i not in self.dead:
+                return node
+        raise RuntimeError("every node is crashed")
+
+    # -- chaos harness (crash / restart; loopback mode) --------------------
+
+    def crash_node(self, i: int) -> None:
+        """Hard-crash node ``i``: unregister it from the transport (no more
+        gossip/RPC in either direction). Its validators stop attesting and
+        its proposal slots are simply missed — the liveness the chaos
+        scenario asserts must survive that."""
+        assert self.mode == "loopback", "crash/restart drives the loopback sim"
+        node = self.nodes[i]
+        self.transport.unregister(node.node_id)
+        self.dead.add(i)
+        self._chaos_seen = True
+
+    def reconnect_all(self) -> None:
+        """Status-handshake every live pair (the chaos epilogue): a
+        straggler that missed tip blocks under gossip loss range-syncs
+        back to the canonical head."""
+        for i, svc in enumerate(self.nodes):
+            if i in self.dead:
+                continue
+            for peer in self.transport.peers(exclude=svc.node_id):
+                try:
+                    svc.connect(peer)
+                except ConnectionError:
+                    pass
+
+    def restart_node(self, i: int) -> None:
+        """Restart node ``i`` from genesis state under the same id (the
+        datadir-wiped worst case) and status-handshake every live peer —
+        range sync walks it back to the head, exactly the partitioned-node
+        recovery path."""
+        assert i in self.dead, f"node {i} is not crashed"
+        svc = BeaconNodeService(
+            f"node_{i}",
+            self.spec,
+            self.harness.state.copy(),
+            self.transport,
+            slot_clock=self.clock,
+            execution_layer=self.harness.el,
+        )
+        self.nodes[i] = svc
+        self.dead.discard(i)
+        for peer in self.transport.peers(exclude=svc.node_id):
+            try:
+                svc.connect(peer)
+            except ConnectionError:
+                pass
 
     # -- per-slot duties ---------------------------------------------------
 
     def _propose(self, slot: int) -> None:
         spec = self.spec
-        # duty lookup on any node's head (all agree or sync will catch up)
-        ref = self.nodes[0].chain
+        # duty lookup on any live node's head (all agree or sync catches up)
+        ref = self._alive_ref().chain
         state = ref.head.state.copy()
         if state.slot < slot:
             process_slots(spec, state, slot)
         proposer = get_beacon_proposer_index(spec, state)
+        if self._owner_index(proposer) in self.dead:
+            return  # a crashed node misses its proposal slot
         node = self._owner_of(proposer)
 
         chain = node.chain
@@ -161,14 +242,25 @@ class LocalNetwork:
         domain_b = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=epoch)
         sig = self.harness._sign(proposer, compute_signing_root(block, domain_b))
         signed = block_cls(message=block, signature=sig)
-        node.chain.process_block(signed)
+        if not self._chaos_active():
+            node.chain.process_block(signed)
+        else:
+            try:
+                node.chain.process_block(signed)
+            except Exception:  # noqa: BLE001 — chaos realism: a proposer
+                # whose head/pool diverged under gossip loss builds a block
+                # its own chain rejects; a real network misses that slot
+                self.missed_proposals += 1
+                return
         node.publish_block(signed)
         self._msg_total += 1
 
     def _attest(self, slot: int) -> None:
         spec = self.spec
         epoch = slot // spec.preset.SLOTS_PER_EPOCH
-        for node, owned in zip(self.nodes, self.owned):
+        for i, (node, owned) in enumerate(zip(self.nodes, self.owned)):
+            if i in self.dead:
+                continue
             state = node.chain.head.state
             if state.slot < slot:
                 state = state.copy()
@@ -229,7 +321,12 @@ class LocalNetwork:
         ]
 
     def heads_agree(self) -> bool:
-        roots = {n.chain.head.root for n in self.nodes}
+        # crashed nodes are excluded: their head is frozen by definition
+        roots = {
+            n.chain.head.root
+            for i, n in enumerate(self.nodes)
+            if i not in self.dead
+        }
         return len(roots) == 1
 
 

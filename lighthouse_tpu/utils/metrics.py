@@ -230,6 +230,41 @@ FIREHOSE_SHUFFLING_CACHE = REGISTRY.counter(
     "Attester/shuffling cache tier lookups (hit / miss)",
     label_names=("result",),
 )
+RESILIENCE_FAULTS = REGISTRY.counter(
+    "resilience_faults_total",
+    "Classified device-path faults (resilience/faults.py taxonomy)",
+    label_names=("domain", "stage", "kind"),
+)
+RESILIENCE_HEALTH = REGISTRY.gauge(
+    "resilience_health_state",
+    "Fault-domain health (0 healthy, 1 degraded, 2 quarantined)",
+    label_names=("domain",),
+)
+RESILIENCE_DEMOTIONS = REGISTRY.counter(
+    "resilience_demotions_total",
+    "Health-state demotions per fault domain",
+    label_names=("domain",),
+)
+RESILIENCE_PROMOTIONS = REGISTRY.counter(
+    "resilience_promotions_total",
+    "Health-state re-promotions per fault domain",
+    label_names=("domain",),
+)
+RESILIENCE_RETRIES = REGISTRY.counter(
+    "resilience_retries_total",
+    "Transient-fault retries on a supervised stage",
+    label_names=("domain", "stage"),
+)
+RESILIENCE_FALLBACK_CALLS = REGISTRY.counter(
+    "resilience_fallback_calls_total",
+    "Supervised calls answered below the full device rung",
+    label_names=("domain", "rung"),
+)
+RESILIENCE_WATCHDOG_TIMEOUTS = REGISTRY.counter(
+    "resilience_watchdog_timeouts_total",
+    "Supervised calls that blew the watchdog deadline (hangs)",
+    label_names=("domain", "stage"),
+)
 SLASHER_CHUNKS_UPDATED = REGISTRY.counter(
     "slasher_chunks_updated_total",
     "Slasher target-array rows updated (slasher/src/metrics.rs)",

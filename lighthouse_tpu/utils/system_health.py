@@ -27,4 +27,12 @@ def system_health(datadir: str | None = None) -> dict:
         out["disk_free_bytes"] = usage.free
     except OSError:
         pass
+    # fault-domain health (resilience.supervisor): backend states, recent
+    # classified faults — degradation must be visible from /health
+    try:
+        from ..resilience import health_snapshot
+
+        out["fault_domains"] = health_snapshot()
+    except Exception:  # noqa: BLE001 — health must never fail the probe
+        pass
     return out

@@ -38,6 +38,14 @@ import time
 ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, ROOT)
 import bench  # shared probe helper + shape ladder + git_head
+# fault taxonomy + standalone watchdog (ISSUE 7): probe/bench failures get a
+# classified verdict in TPU_WINDOW_LOG.jsonl, and a hang at one rung moves
+# the window to the next rung instead of wasting it
+from lighthouse_tpu.resilience import (  # noqa: E402
+    WatchdogTimeout,
+    classify_text,
+    run_with_deadline,
+)
 
 CACHE = os.path.join(ROOT, ".bench_cache")
 LOG = os.path.join(ROOT, "TPU_WINDOW_LOG.jsonl")
@@ -93,19 +101,31 @@ def log(event: str, **kw) -> None:
 def probe() -> str | None:
     """Returns the platform string on a healthy probe, else None. Skips
     (returning None) when a peer bench holds the lock — probing mid-bench
-    would perturb the measurement and a busy device times out anyway."""
+    would perturb the measurement and a busy device times out anyway.
+
+    The probe helper runs under the resilience watchdog on top of its own
+    subprocess timeout (belt and braces: even a wedged ``subprocess.run``
+    cannot pin the daemon), and every failure is logged with a classified
+    fault verdict — a hung probe is a ``hang`` record, not a mystery."""
     try:
         with bench.bench_lock(max_wait=0.0):
-            platform, note = bench.probe_once(PROBE_TIMEOUT_S)
+            platform, note = run_with_deadline(
+                "hunter.probe",
+                lambda: bench.probe_once(PROBE_TIMEOUT_S),
+                PROBE_TIMEOUT_S + 60.0,
+            )
     except bench.BenchLockBusy:
         log("probe_skipped_peer_benching")
+        return None
+    except WatchdogTimeout as e:
+        log("probe_failed", note=str(e), fault_kind="hang")
         return None
     if platform == "tpu":
         log("probe_ok", note=note)
     elif platform is not None:
         log("probe_wrong_platform", platform=platform, note=note)
     else:
-        log("probe_failed", note=note)
+        log("probe_failed", note=note, fault_kind=classify_text(note).value)
     return platform
 
 
@@ -170,9 +190,11 @@ def save_state(st: dict) -> None:
     bench.atomic_write_json(STATE, st)
 
 
-def run_rung(rung_idx: int) -> dict | None:
+def run_rung(rung_idx: int) -> tuple[dict | None, str | None]:
     """Run one ladder rung via bench.run_inner (shared subprocess runner,
-    serialized against a concurrent bench.py by the cross-process lock)."""
+    serialized against a concurrent bench.py by the cross-process lock).
+    Returns (record | None, classified fault kind | None) — the kind drives
+    the window scheduler: a ``hang`` skips to the next rung."""
     sets, keys, validators, batch, timeout, mode = RUNGS[rung_idx]
     log("bench_start", rung=rung_idx, sets=sets, keys=keys, batch=batch,
         mode=mode)
@@ -182,15 +204,17 @@ def run_rung(rung_idx: int) -> dict | None:
     )
     dt = time.perf_counter() - t0
     if rec is None:
-        log("bench_failed", rung=rung_idx, seconds=round(dt, 1), note=note)
-        return None
+        kind = classify_text(note).value
+        log("bench_failed", rung=rung_idx, seconds=round(dt, 1), note=note,
+            fault_kind=kind)
+        return None, kind
     rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     rec["git_head"] = bench.git_head()
     rec["window_hunter"] = True
     rec["wall_seconds"] = round(dt, 1)
     log("bench_ok", rung=rung_idx, platform=rec.get("platform"),
         value=rec.get("value"), seconds=round(dt, 1))
-    return rec
+    return rec, None
 
 
 def persist(rec: dict, rung_idx: int) -> None:
@@ -238,17 +262,29 @@ def main() -> None:
                 # benching an unsound kernel is a window wasted (ISSUE 5)
                 log("window_skipped_uncertified_kernels")
             elif platform == "tpu":
-                # a window is open: climb rungs until one fails or all done
-                while st["next_rung"] < len(RUNGS):
+                # a window is open: climb rungs until one fails or all done.
+                # `cursor` is the window-local rung pointer: a HANG verdict
+                # advances it past the wedged rung (the window keeps
+                # producing records) while the persistent next_rung cursor
+                # stays put so a later window retries the hung rung.
+                cursor = st["next_rung"]
+                while cursor < len(RUNGS):
                     if bench.bench_main_in_progress():
                         # a bench.py probe+ladder phase owns the device:
                         # starting a rung now would corrupt its measurement
                         log("rung_skipped_bench_in_progress")
                         break
-                    rec = run_rung(st["next_rung"])
+                    rec, fault_kind = run_rung(cursor)
                     if rec is None:
-                        key = str(st["next_rung"])
+                        key = str(cursor)
                         st["failures"][key] = st["failures"].get(key, 0) + 1
+                        if fault_kind == "hang":
+                            # the watchdog reclaimed the window: move to the
+                            # next rung instead of wasting what remains
+                            log("rung_hang_next", rung=cursor)
+                            save_state(st)
+                            cursor += 1
+                            continue
                         st["cooldown"] = min(2 ** st["failures"][key], 8)
                         save_state(st)
                         break
@@ -256,15 +292,17 @@ def main() -> None:
                         log("bench_wrong_platform",
                             platform=rec.get("platform"))
                         break
-                    persist(rec, st["next_rung"])
-                    st["next_rung"] += 1
+                    persist(rec, cursor)
+                    if cursor == st["next_rung"]:
+                        st["next_rung"] += 1
+                    cursor += 1
                     save_state(st)
                 if st["next_rung"] >= len(RUNGS) and not (
                     bench.bench_main_in_progress()
                 ):
                     # all rungs conquered with current kernels; re-run the
                     # top rung occasionally in case kernels improved
-                    rec = run_rung(len(RUNGS) - 1)
+                    rec, _ = run_rung(len(RUNGS) - 1)
                     if rec and rec.get("platform") == "tpu":
                         persist(rec, len(RUNGS) - 1)
                     time.sleep(PROBE_PERIOD_S * 4)
