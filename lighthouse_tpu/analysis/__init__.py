@@ -1,6 +1,7 @@
-"""Static-analysis subsystem: machine-checked kernel certification.
+"""Static-analysis subsystem: machine-checked kernel + concurrency
+certification.
 
-Three passes, run in tier-1 CI (``tests/test_analysis.py``), by the TPU
+Five passes, run in tier-1 CI (``tests/test_analysis.py``), by the TPU
 window hunter's preflight (``tools_tpu_hunter.py``), and by hand via
 ``python -m lighthouse_tpu.analysis``:
 
@@ -18,9 +19,29 @@ window hunter's preflight (``tools_tpu_hunter.py``), and by hand via
 * **Pass 3 — recompilation sentinel** (``recompile.py``): a
   compilation-count hook (``jax_log_compiles`` capture) asserting that
   steady-state loops — the firehose verify pipeline, the epoch-engine
-  sweep — trigger ZERO recompiles after warm-up.
+  sweep — trigger ZERO recompiles after warm-up; ``recompile_probe()``
+  is the CLI's cheap in-process check of the capture plumbing.
+* **Pass 4 — supervisor-transparency probe** (``supervised.py``): the
+  resilience wrappers lint clean, add zero steady-state recompiles, and
+  return the kernel's result bit for bit.
+* **Pass 5 — concurrency certifier** (``concurrency.py``): lock-discipline
+  proofs over every module importing ``threading`` (guard inference,
+  unguarded shared mutations, thread-lifecycle joins), a package-wide
+  acquires-while-holding lock-order graph that must stay acyclic with a
+  blocking-call-under-lock rule, and an env-gated runtime lockdep wrapper
+  (``LIGHTHOUSE_LOCKDEP=1``) whose observed acquisition orders are merged
+  back into the static graph. Emits ``CONCURRENCY_CERT.json``.
 """
 
 from .bounds import certify, certify_callable, write_cert  # noqa: F401
+from .concurrency import (  # noqa: F401
+    certify_concurrency,
+    lockdep_enabled,
+    merge_observed,
+)
 from .hygiene import lint_tree  # noqa: F401
-from .recompile import CompilationSentinel, steady_state_compiles  # noqa: F401
+from .recompile import (  # noqa: F401
+    CompilationSentinel,
+    recompile_probe,
+    steady_state_compiles,
+)

@@ -1,11 +1,20 @@
-"""``python -m lighthouse_tpu.analysis`` — run the kernel certifier + linter.
+"""``python -m lighthouse_tpu.analysis`` — run the five-pass certifier suite.
 
 Exit code 0 iff every selected pass is clean. ``--json`` emits one machine-
 readable report on stdout (the hunter preflight consumes it); the default
-output is human-oriented. The recompilation sentinel is a *runtime* hook
-(it needs a live loop to watch), so it is exercised by tests/test_analysis.py
-and the bench rungs rather than by this CLI; ``--bounds``/``--lint`` select
-passes, default is both.
+output is human-oriented. ``--bounds`` / ``--lint`` / ``--recompile`` /
+``--supervisor`` / ``--concurrency`` select individual passes; with no
+selection all five run:
+
+1. **bounds** — the static limb-bound certifier (``BOUNDS_CERT.json``);
+2. **lint** — the trace-hygiene linter;
+3. **recompile** — the runtime sentinel probe (a warm jit loop must stay
+   at zero compiles; the deep serving loops are covered by
+   ``tests/test_analysis.py`` and the bench rungs);
+4. **supervisor** — the supervisor-transparency probe;
+5. **concurrency** — the lock-discipline certifier + lock-order deadlock
+   graph (``CONCURRENCY_CERT.json``), merging a ``LOCKDEP_OBSERVED.json``
+   runtime graph when one is present (see ``LIGHTHOUSE_LOCKDEP=1``).
 """
 
 from __future__ import annotations
@@ -16,21 +25,48 @@ import os
 import sys
 
 
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="lighthouse_tpu.analysis")
     ap.add_argument("--json", action="store_true", help="machine-readable report")
     ap.add_argument("--bounds", action="store_true", help="run only the limb-bound certifier")
     ap.add_argument("--lint", action="store_true", help="run only the trace-hygiene linter")
     ap.add_argument(
+        "--recompile", action="store_true",
+        help="run only the recompilation-sentinel probe",
+    )
+    ap.add_argument(
         "--supervisor", action="store_true",
         help="run only the supervisor-transparency probe (lint-clean "
         "resilience wrappers + zero steady-state recompiles)",
+    )
+    ap.add_argument(
+        "--concurrency", action="store_true",
+        help="run only the concurrency certifier (lock discipline + "
+        "deadlock graph + lockdep cross-check)",
     )
     ap.add_argument(
         "--cert-out",
         default=None,
         help="write BOUNDS_CERT.json here (default: repo root when the bounds"
         " pass runs, '-' to skip)",
+    )
+    ap.add_argument(
+        "--concurrency-cert-out",
+        default=None,
+        help="write CONCURRENCY_CERT.json here (default: repo root when the"
+        " concurrency pass runs, '-' to skip)",
+    )
+    ap.add_argument(
+        "--observed",
+        default=None,
+        help="lockdep observed-graph JSON to merge into the concurrency cert"
+        " (default: LOCKDEP_OBSERVED.json beside the cert when present)",
     )
     ap.add_argument(
         "--graphs", nargs="*", default=None,
@@ -41,10 +77,15 @@ def main(argv=None) -> int:
         help="batch regimes to certify (default 1 32)",
     )
     args = ap.parse_args(argv)
-    any_selected = args.bounds or args.lint or args.supervisor
+    any_selected = (
+        args.bounds or args.lint or args.recompile or args.supervisor
+        or args.concurrency
+    )
     run_bounds = args.bounds or not any_selected
     run_lint = args.lint or not any_selected
+    run_recompile = args.recompile or not any_selected
     run_supervisor = args.supervisor or not any_selected
+    run_concurrency = args.concurrency or not any_selected
 
     report: dict = {"ok": True}
     rc = 0
@@ -64,6 +105,21 @@ def main(argv=None) -> int:
                 f"{len(sup_rep['steady_state_compiles'])} steady-state "
                 f"recompile(s), transparent={sup_rep['transparent']} — "
                 f"{'ok' if sup_rep['ok'] else 'FAIL'}",
+                file=sys.stderr,
+            )
+
+    if run_recompile:
+        from .recompile import recompile_probe
+
+        rec_rep = recompile_probe()
+        report["recompile"] = rec_rep
+        if not rec_rep["ok"]:
+            report["ok"] = False
+            rc = 1
+        if not args.json:
+            print(
+                f"recompile: {len(rec_rep['steady_state_compiles'])} steady-"
+                f"state compile(s) — {'ok' if rec_rep['ok'] else 'FAIL'}",
                 file=sys.stderr,
             )
 
@@ -89,6 +145,53 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
 
+    if run_concurrency:
+        from .concurrency import certify_concurrency
+        from .concurrency import write_cert as write_ccert
+
+        observed = args.observed
+        if observed is None:
+            default_obs = os.path.join(_repo_root(), "LOCKDEP_OBSERVED.json")
+            observed = default_obs if os.path.exists(default_obs) else None
+        ccert = certify_concurrency(observed_path=observed)
+        out = args.concurrency_cert_out
+        if out is None:
+            out = os.path.join(_repo_root(), "CONCURRENCY_CERT.json")
+        if out != "-":
+            write_ccert(ccert, out)
+        report["concurrency"] = {
+            "ok": ccert["ok"],
+            "n_findings": ccert["n_findings"],
+            "n_baseline_suppressed": ccert["n_baseline_suppressed"],
+            "n_lock_classes": ccert["n_lock_classes"],
+            "n_edges": len(ccert["lock_graph"]["edges"]),
+            "cycles": ccert["cycles"],
+            "lockdep_ok": ccert["lockdep"]["ok"],
+            "n_observed_edges": ccert["lockdep"]["n_observed_edges"],
+            "findings": ccert["findings"],
+            "cert_path": None if out == "-" else out,
+        }
+        if not ccert["ok"]:
+            report["ok"] = False
+            rc = 1
+        if not args.json:
+            for f in ccert["findings"]:
+                print(
+                    f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}",
+                    file=sys.stderr,
+                )
+            for cyc in ccert["cycles"]:
+                print(f"lock-order cycle: {cyc}", file=sys.stderr)
+            print(
+                f"concurrency: {ccert['n_findings']} finding(s),"
+                f" {ccert['n_baseline_suppressed']} baseline-suppressed,"
+                f" {len(ccert['lock_graph']['edges'])} lock-order edge(s),"
+                f" {len(ccert['cycles'])} cycle(s),"
+                f" {ccert['lockdep']['n_observed_edges']} observed edge(s) —"
+                f" {'ok' if ccert['ok'] else 'FAIL'}",
+                file=sys.stderr,
+            )
+
     if run_bounds:
         from .bounds import certify, write_cert
 
@@ -98,12 +201,7 @@ def main(argv=None) -> int:
         cert = certify(graphs=args.graphs, **kw)
         out = args.cert_out
         if out is None:
-            out = os.path.join(
-                os.path.dirname(
-                    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-                ),
-                "BOUNDS_CERT.json",
-            )
+            out = os.path.join(_repo_root(), "BOUNDS_CERT.json")
         if out != "-":
             write_cert(cert, out)
         report["bounds"] = {

@@ -30,7 +30,7 @@ import logging
 import re
 import threading
 
-__all__ = ["CompilationSentinel", "steady_state_compiles"]
+__all__ = ["CompilationSentinel", "steady_state_compiles", "recompile_probe"]
 
 _COMPILE_RE = re.compile(r"^Compiling ([^\s]+) with global shapes")
 
@@ -120,3 +120,20 @@ def steady_state_compiles(step_fn, warmup: int = 2, steps: int = 3) -> list[str]
         for _ in range(steps):
             step_fn()
         return sentinel.compiles_since(mark)
+
+
+def recompile_probe(steps: int = 4) -> dict:
+    """The CLI's runtime sentinel check: a warm jit loop must stay at zero
+    compiles. Proves the capture plumbing (jax_log_compiles hook, logger
+    wiring) works in this process — the deep loops (firehose, epoch
+    engine) are sentinel-checked by tests/test_analysis.py and the bench
+    rungs where their compile cost belongs."""
+    import jax
+    import jax.numpy as jnp
+
+    kern = jax.jit(lambda x: (x * 2 + 1).sum())
+    x = jnp.arange(64, dtype=jnp.int32)
+    names = steady_state_compiles(
+        lambda: kern(x).block_until_ready(), warmup=2, steps=steps
+    )
+    return {"ok": names == [], "steady_state_compiles": names}

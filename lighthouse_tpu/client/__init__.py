@@ -54,6 +54,7 @@ class Client:
         self.notifier = notifier
         self.network_service = network_service
         self._shutdown = threading.Event()
+        self._threads: list[threading.Thread] = []
 
     def start(self) -> "Client":
         if self.http_server is not None:
@@ -70,10 +71,16 @@ class Client:
                 name="slasher-tick",
             )
             self._slasher_ticker.start()
+            self._threads.append(self._slasher_ticker)
         if self.chain.eth1_service is not None:
-            threading.Thread(
+            th = threading.Thread(
                 target=self._run_eth1_polls, daemon=True, name="eth1-poll"
-            ).start()
+            )
+            th.start()
+            self._threads.append(th)
+        # the warmup thread is deliberately NOT joined on stop: it runs one
+        # uninterruptible best-effort compile and exits — joining it would
+        # stall every shutdown behind XLA for no correctness gain
         threading.Thread(
             target=self._warmup_bls, daemon=True, name="bls-warmup"
         ).start()
@@ -129,6 +136,10 @@ class Client:
 
     def stop(self) -> None:
         self._shutdown.set()
+        for th in self._threads:
+            # the periodic loops wake from their interval wait the moment
+            # the shutdown event sets, so these joins return in ms
+            th.join(timeout=2.0)
         if self.notifier is not None:
             self.notifier.stop()
         if self.http_server is not None:

@@ -48,3 +48,7 @@ class Notifier:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            # the loop wakes from its interval wait as soon as the event
+            # sets, so a short bounded join reclaims the thread
+            self._thread.join(timeout=5.0)

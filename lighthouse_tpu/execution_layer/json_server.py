@@ -73,6 +73,8 @@ class ExecutionJsonRpcServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     # -- request handling ---------------------------------------------------
 

@@ -143,7 +143,8 @@ class FirehoseEngine:
 
     def _prep_batch(self, batch: list[FirehoseItem]):
         """Host stage: payloads -> signature-set groups (or Exceptions)."""
-        self.batches_formed += 1
+        with self._stats_lock:
+            self.batches_formed += 1
         FIREHOSE_BATCHES_FORMED.inc(work_type=batch[0].work_type.name)
         FIREHOSE_BATCH_FILL.observe(len(batch))
         groups = self.prepare_fn([it.payload for it in batch])

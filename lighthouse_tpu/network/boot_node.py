@@ -31,7 +31,7 @@ class BootNode:
         self.local_addr = f"{host}:{self._sock.getsockname()[1]}"
         self._known: OrderedDict[str, None] = OrderedDict()
         self._lock = threading.Lock()
-        self._stopped = False
+        self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def start(self) -> "BootNode":
@@ -43,18 +43,20 @@ class BootNode:
         return self
 
     def stop(self) -> None:
-        self._stopped = True
+        self._stop.set()
         try:
-            self._sock.close()
+            self._sock.close()   # unblocks the recvfrom in the serve loop
         except OSError:
             pass
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def known_peers(self) -> list[str]:
         with self._lock:
             return list(self._known)
 
     def _serve(self) -> None:
-        while not self._stopped:
+        while not self._stop.is_set():
             try:
                 data, src = self._sock.recvfrom(4096)
             except OSError:

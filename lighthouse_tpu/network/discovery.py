@@ -341,14 +341,19 @@ class DiscoveryService:
             self._sock.close()
         except OSError:
             pass
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def update_tcp_port(self, port: int) -> None:
         """Re-sign the local ENR with the final TCP listen port (the
-        transport binds after discovery starts); bumps seq."""
-        self.enr = ENR(
-            self.enr.seq + 1, self.enr.fork_digest, self.enr.ip, port,
-            self.enr.udp_addr[1], self.pubkey,
-        ).sign(self.sk)
+        transport binds after discovery starts); bumps seq. The serve
+        thread answers FINDNODE from self.enr concurrently, so the
+        read-bump-resign sequence must be atomic under the pending lock."""
+        with self._pending_lock:
+            self.enr = ENR(
+                self.enr.seq + 1, self.enr.fork_digest, self.enr.ip, port,
+                self.enr.udp_addr[1], self.pubkey,
+            ).sign(self.sk)
 
     # -- record admission --------------------------------------------------
 

@@ -654,8 +654,12 @@ class GossipsubTransport(SocketTransport):
             to_send.setdefault(pr, []).append(
                 (_IHAVE, topic, ids[: p.max_ihave_ids])
             )
-            self.ihave_sent += 1
+            # heartbeat thread and the publish path both bump this counter
+            with self._gs_lock:
+                self.ihave_sent += 1
 
     def stop(self) -> None:
         self._hb_stop.set()
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            self._hb_thread.join(timeout=5.0)
         super().stop()

@@ -81,7 +81,10 @@ class _Peer:
     def send_frame(self, kind: int, body: bytes) -> None:
         frame = struct.pack(">IB", len(body) + 1, kind) + body
         with self.send_lock:
-            self.sock.sendall(frame)
+            # the send lock exists precisely to serialize whole frames onto
+            # the socket; it guards nothing else and nothing is acquired
+            # under it, so holding it across the write cannot deadlock
+            self.sock.sendall(frame)  # lint: allow(blocking-under-lock)
 
 
 class SocketTransport(Transport):
@@ -123,10 +126,12 @@ class SocketTransport(Transport):
             self.discovery.peer_manager = self.peer_manager
             self.discovery.update_tcp_port(self._listener.getsockname()[1])
         self._stopped = False
-        threading.Thread(
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"net-accept-{self.local_addr}",
-        ).start()
+        )
+        self._accept_thread.start()
 
     # -- Transport seam ----------------------------------------------------
 
@@ -260,8 +265,15 @@ class SocketTransport(Transport):
         with self._lock:
             peers = list(self._peers.values())
             self._peers.clear()
+            readers = list(self._threads)
+            self._threads.clear()
         for p in peers:
             _shutdown_close(p.sock)
+        # closing the listener/sockets unblocks both loops; the joins are
+        # bounded so a half-closed socket can never wedge shutdown
+        self._accept_thread.join(timeout=2.0)
+        for th in readers:
+            th.join(timeout=2.0)
 
     # -- internals ---------------------------------------------------------
 
@@ -276,10 +288,14 @@ class SocketTransport(Transport):
         peer.send_frame(
             _HELLO, bytes([len(self.local_addr)]) + self.local_addr.encode()
         )
-        threading.Thread(
+        th = threading.Thread(
             target=self._read_loop, args=(peer,), daemon=True,
             name=f"net-read-{addr}",
-        ).start()
+        )
+        th.start()
+        with self._lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
+            self._threads.append(th)
         return peer
 
     def _accept_loop(self) -> None:

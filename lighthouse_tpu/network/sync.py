@@ -47,6 +47,7 @@ class SyncManager:
         self._stopped = False
         self._threaded = threaded
         self._thread = None
+        self._lookup_threads: list[threading.Thread] = []
         if threaded:
             self._thread = threading.Thread(
                 target=self._worker, daemon=True,
@@ -57,6 +58,12 @@ class SyncManager:
     def stop(self) -> None:
         self._stopped = True
         self._wake.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            lookups = list(self._lookup_threads)
+        for th in lookups:
+            th.join(timeout=2.0)
 
     # -- peer intake -------------------------------------------------------
 
@@ -296,11 +303,17 @@ class SyncManager:
                 return
             inflight.add(root)
         if self._threaded:
-            threading.Thread(
+            th = threading.Thread(
                 target=self._parent_lookup_tracked,
                 args=(root, signed_block, from_peer),
                 daemon=True, name="sync-lookup",
-            ).start()
+            )
+            th.start()
+            with self._lock:
+                self._lookup_threads[:] = [
+                    t for t in self._lookup_threads if t.is_alive()
+                ]
+                self._lookup_threads.append(th)
         else:
             self._parent_lookup_tracked(root, signed_block, from_peer)
 

@@ -247,7 +247,11 @@ class BackendSupervisor:
                         if self._hung_threads < self.config.max_hung_threads:
                             self._hard_quarantined = False
 
-        th = threading.Thread(
+        # watchdog workers are deliberately never joined: a wedged device
+        # call cannot be killed, so the hang model ABANDONS the thread and
+        # counts it against max_hung_threads instead (bounded by the hard
+        # quarantine); done.wait(deadline) is the bounded reclaim
+        th = threading.Thread(  # lint: allow(unjoined-thread)
             target=worker, daemon=True, name=f"watchdog-{self.name}-{stage}"
         )
         th.start()
@@ -466,7 +470,9 @@ def run_with_deadline(stage: str, fn, deadline_s: float):
         finally:
             done.set()
 
-    th = threading.Thread(target=worker, daemon=True, name=f"watchdog-{stage}")
+    # same abandonment contract as _with_watchdog: the probe thread may be
+    # wedged inside the device client and cannot be joined
+    th = threading.Thread(target=worker, daemon=True, name=f"watchdog-{stage}")  # lint: allow(unjoined-thread)
     th.start()
     if not done.wait(deadline_s):
         raise WatchdogTimeout(stage, deadline_s)

@@ -44,6 +44,8 @@ class KeymanagerServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     # -- handlers ----------------------------------------------------------
 

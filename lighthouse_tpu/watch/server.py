@@ -33,6 +33,8 @@ class WatchServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
 
 def _make_handler(api: WatchServer):

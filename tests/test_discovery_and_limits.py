@@ -300,6 +300,12 @@ def test_spoofed_findnode_challenged_before_signature_work(monkeypatch):
         # NODES record — the amplification payload the gate must withhold
         peer.bootstrap(srv.enr)
         assert _wait_for(lambda: len(srv.table) == 1)
+        # stop the live peer and let srv drain in-flight datagrams BEFORE
+        # counting verifies: background liveness PING/PONG between the two
+        # serve loops performs legitimate ENR verification that would
+        # otherwise race the `verifies == []` assertion below
+        peer.stop()
+        time.sleep(0.2)
 
         verifies = []
         orig_verify = ENR.verify
@@ -538,7 +544,11 @@ def test_banned_peer_stays_out_of_transport_and_table():
         assert not a.dial(bt.local_addr)
         assert a.discover_enr() is not None  # lookup must not re-admit
         assert bt.local_addr not in a.peers()
-        # B dialing A is cut at HELLO (reconnect suppression)
+        # B dialing A is cut at HELLO (reconnect suppression). B must first
+        # OBSERVE the drop on its reader thread — dial() refuses an address
+        # still present in its peer table, so re-dialing too early races
+        # the disconnect notification
+        assert _wait_for(lambda: a.local_addr not in bt.peers())
         assert bt.dial(a.local_addr)
         time.sleep(1.0)
         assert bt.local_addr not in a.peers()

@@ -130,7 +130,9 @@ def probe() -> str | None:
 
 
 # ISSUE 5 preflight: a TPU window must never be spent benching a kernel tree
-# that fails static certification (limb-bound proofs / trace-hygiene lint).
+# that fails static certification (limb-bound proofs / trace-hygiene lint /
+# concurrency cert — a racy or deadlock-prone host pipeline wastes a window
+# just as surely as a bad kernel).
 # Memoized per git HEAD — the daemon outlives commits, so a new HEAD re-runs
 # the analysis; a definitive verdict (clean/dirty) sticks for that HEAD.
 _preflight: dict = {"head": None, "ok": None}
@@ -145,7 +147,7 @@ def kernels_certified() -> bool:
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "lighthouse_tpu.analysis", "--json",
-             "--cert-out", "-"],
+             "--cert-out", "-", "--concurrency-cert-out", "-"],
             cwd=ROOT, env=env, capture_output=True, text=True,
             timeout=PREFLIGHT_TIMEOUT_S,
         )
@@ -161,6 +163,8 @@ def kernels_certified() -> bool:
             "lint_findings": rep.get("lint", {}).get("n_findings"),
             "bounds_failed": rep.get("bounds", {}).get("n_failed"),
             "min_margin_bits": rep.get("bounds", {}).get("min_margin_bits"),
+            "concurrency_findings": rep.get("concurrency", {}).get("n_findings"),
+            "lock_cycles": len(rep.get("concurrency", {}).get("cycles", [])),
         }
     except (ValueError, IndexError):
         # no parseable report: a clean exit makes no sense, and a nonzero
