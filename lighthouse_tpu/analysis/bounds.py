@@ -146,6 +146,7 @@ def graph_registry(batch: int) -> list[tuple]:
     import jax
     import jax.numpy as jnp
 
+    from ..bls import tpu_backend as tb
     from ..ops.bls import curve, fq, h2c, pairing, tower
     from ..ops.bls_oracle.fields import BLS_X
 
@@ -234,6 +235,32 @@ def graph_registry(batch: int) -> list[tuple]:
         ("pairing.fq12_prod3",
          lambda a, b, c: pairing.fq12_prod(jnp.stack([a, b, c])),
          (e12, e12, e12)),
+        # bls/tpu_backend.py — the sharded serving tier's shard-LOCAL
+        # bodies (ISSUE 10): what each device of the mesh executes per
+        # tick. The shard_map wrapper only partitions data; the bound
+        # obligations live entirely in these local compositions, so the
+        # certifier proves them at the per-shard batch shape.
+        ("tpu_backend.shard_local_prep",
+         tb._local_prep_partials,
+         (
+             jax.ShapeDtypeStruct((64, 3, 25), u64),         # pubkey cache
+             jax.ShapeDtypeStruct(B + (4,), jnp.int32),      # idx
+             jax.ShapeDtypeStruct(B + (4,), jnp.bool_),      # mask
+             e1, e1,                                         # sig x limbs
+             sc,                                             # s_flag
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # sig_wf
+             sc,                                             # scalars
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # valid
+         )),
+        ("tpu_backend.shard_local_pair_verdict",
+         tb._local_pair_verdict,
+         (
+             s(1, 25), s(1, 25),                             # pkx, pky
+             e2, e2,                                         # msg affine
+             jax.ShapeDtypeStruct((6, 25), u64),             # sig partial
+             jax.ShapeDtypeStruct((), jnp.bool_),            # ok_part
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # valid
+         )),
     ]
 
 

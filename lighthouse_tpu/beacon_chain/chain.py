@@ -110,6 +110,22 @@ class BeaconChain:
         )
         # firehose hot path prunes the naive pool at most once per slot
         self._naive_pool_pruned_slot = -1
+        # early-attester cache (early_attester_cache.rs): attestation data
+        # for the current head served without a state read; primed on every
+        # head update under the chain lock
+        from .early_attester_cache import EarlyAttesterCache
+
+        self.early_attester_cache = EarlyAttesterCache()
+        # sharded serving tier (firehose/sharding.py over bls/mesh.py):
+        # resolved lazily on the first batch verify — None when
+        # LIGHTHOUSE_MESH_DEVICES leaves the mesh off (the single-device
+        # path, bit-identical to the pre-mesh engine). Creation is guarded
+        # by its own small lock (gossip + HTTP threads race the first
+        # verify); dispatches never hold it
+        import threading as _threading
+
+        self._mesh_planner_state = "unset"
+        self._mesh_planner_lock = _threading.Lock()
 
         # genesis anchor: the canonical block root needs the header's
         # state_root filled (it is zero until the next process_slot)
@@ -807,9 +823,52 @@ class BeaconChain:
             except SupervisedFault:
                 return False  # every rung faulted (recorded): fail closed
 
+    def _mesh_planner(self):
+        """The sharded serving tier for this chain, or None when the mesh
+        is off (``LIGHTHOUSE_MESH_DEVICES`` unset/1 — the single-device
+        path stays bit-identical to the pre-mesh engine). Resolved once;
+        the verifier itself holds no state, so it is shared by the batch
+        paths and the firehose threads."""
+        if self._mesh_planner_state == "unset":
+            with self._mesh_planner_lock:
+                if self._mesh_planner_state == "unset":
+                    self._mesh_planner_state = self._build_mesh_planner()
+        return self._mesh_planner_state
+
+    def _build_mesh_planner(self):
+        if bls.get_backend() != "tpu":
+            return None
+        from ..bls import mesh as bls_mesh
+
+        n = bls_mesh.serving_mesh_size()
+        if n <= 1:
+            return None
+        from ..bls import tpu_backend as tb
+        from ..firehose.sharding import MeshVerifier
+
+        backend = bls_mesh.make_mesh_backend(self.pubkey_cache.device_array)
+        return MeshVerifier(
+            n,
+            dispatch_fn=backend.dispatch,
+            stage_fn=backend.stage,
+            probe_fn=backend.probe,
+            single_fn=lambda its: tb.verify_indexed_sets_device(
+                self.pubkey_cache.device_array(), its
+            ),
+            oracle_fn=lambda its: self._verify_items_via_sets(
+                its, oracle=True
+            ),
+        )
+
     def _batch_verify_items_inner(self, items) -> bool:
         from ..resilience import bls_supervisor
 
+        mesh = self._mesh_planner()
+        if mesh is not None:
+            # the mesh verifier carries its own fault-domain ladder
+            # (mesh N -> N/2 -> single device -> CPU oracle) — wrapping it
+            # in the bls_device supervisor too would double-wrap
+            return mesh.verify_items(items)
         sup = bls_supervisor()
         if bls.get_backend() == "tpu":
             from ..bls import tpu_backend as tb
@@ -1086,6 +1145,11 @@ class BeaconChain:
             verify_items_fn=self._batch_verify_items,
             config=config,
             synchronous=synchronous,
+            # sharded serving tier (None when the mesh is off): per-shard
+            # sub-batches with prep-thread H2D staging, per-shard verdicts,
+            # per-shard fault domains — aggregates stream through it as
+            # atomic 3-set groups exactly like single-set attestations
+            shard_planner=self._mesh_planner(),
         )
         engine.default_callback = self._apply_verified_attestation
         return engine
@@ -1297,6 +1361,10 @@ class BeaconChain:
                 self.head = ChainHead(
                     root=head_root, slot=state.slot, state=state
                 )
+                try:
+                    self.early_attester_cache.prime(self.spec, head_root, state)
+                except Exception:  # noqa: BLE001 — cache priming best-effort
+                    self.early_attester_cache.evict()
                 self._emit_event(
                     "head",
                     lambda: {

@@ -390,6 +390,27 @@ def _sharded_h2c_stage(mesh, n_pad: int):
     ))
 
 
+def _local_prep_partials(cache, idx, mask, sxc0, sxc1, s_flag, sig_wf,
+                         scalars, valid):
+    """Shard-local body of ``_sharded_prep_stage``: signature decompression,
+    replicated-cache gather, masked aggregation, and the security prologue
+    over one device's slice, emitting the shard's G2 signature partial sum
+    + combined set_ok. Module-level so the bounds certifier re-executes it
+    as its own op graph (``analysis/bounds.graph_registry``)."""
+    from .serde import raw_to_mont
+
+    x_mont = raw_to_mont(jnp.stack([sxc0, sxc1], axis=-2))
+    sig, on_curve = g2.decompress(x_mont, s_flag)
+    pts = cache[idx]
+    pk_agg = curve.point_sum(
+        1, jnp.moveaxis(pts, 1, 0), jnp.moveaxis(mask, 1, 0)
+    )
+    set_ok, pk_scaled, sig_part = _set_prologue(pk_agg, sig, scalars, valid)
+    set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(mask, axis=1)))
+    pkx, pky = g1.to_affine(pk_scaled)
+    return pkx, pky, sig_part[None], jnp.all(set_ok)[None]
+
+
 @functools.lru_cache(maxsize=None)
 def _sharded_prep_stage(mesh, n_pad: int, k_pad: int):
     """Sharded twin of ``_prep_stage``: pubkey cache REPLICATED (every chip
@@ -400,22 +421,8 @@ def _sharded_prep_stage(mesh, n_pad: int, k_pad: int):
     shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
-    from .serde import raw_to_mont
-
-    def local(cache, idx, mask, sxc0, sxc1, s_flag, sig_wf, scalars, valid):
-        x_mont = raw_to_mont(jnp.stack([sxc0, sxc1], axis=-2))
-        sig, on_curve = g2.decompress(x_mont, s_flag)
-        pts = cache[idx]
-        pk_agg = curve.point_sum(
-            1, jnp.moveaxis(pts, 1, 0), jnp.moveaxis(mask, 1, 0)
-        )
-        set_ok, pk_scaled, sig_part = _set_prologue(pk_agg, sig, scalars, valid)
-        set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(mask, axis=1)))
-        pkx, pky = g1.to_affine(pk_scaled)
-        return pkx, pky, sig_part[None], jnp.all(set_ok)[None]
-
     return jax.jit(shard_map(
-        local, mesh=mesh,
+        _local_prep_partials, mesh=mesh,
         in_specs=(P(),) + (P("sets"),) * 8,
         out_specs=(P("sets"),) * 4,
     ))
@@ -565,6 +572,175 @@ def verify_indexed_sets_sharded(cache_arr, items, mesh) -> bool:
     return bool(np.asarray(ok))
 
 
+def _local_pair_verdict(pkx, pky, mxa, mya, sig_part, ok_part, valid):
+    """Shard-local pairing epilogue for the PER-SHARD-verdict serving path:
+    the device's local Miller product, one local Miller loop of the shard's
+    signature partial sum against -g1, and the shard's OWN final
+    exponentiation — no cross-device collectives at all, so each shard's
+    verdict stands alone (a poisoned or faulted shard condemns only its own
+    sub-batch, never the whole mesh tick).
+
+    Inputs are one device's slice: pkx/pky [c, 1, 25] affine scaled pubkeys,
+    mxa/mya [c, 2, 25] affine message points, sig_part [6, 25] the shard's
+    masked signature sum, ok_part scalar bool, valid [c]. Returns scalar
+    bool. Registered in ``analysis/bounds.graph_registry`` (the serving
+    tier's new op-graph composition)."""
+    f_batch = pairing.miller_product(pkx[:, 0, :], pky[:, 0, :], mxa, mya, valid)
+    sx, sy = g2.to_affine(sig_part)
+    f_last = pairing.miller_loop(_MG1_X, _MG1_Y, sx, sy)
+    f = tower.fq12_mul(f_batch, f_last)
+    ok = tower.fq12_is_one(pairing.final_exponentiation(f))
+    return ok & ok_part & jnp.any(valid)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_verdict_stage(mesh, n_pad: int):
+    """Per-shard verdict epilogue: each device runs ``_local_pair_verdict``
+    on its own slice and emits ONE bool — the gathered [n_dev] output is the
+    per-shard verdict vector (the cross-device combine of the serving tier:
+    an output gather, no arithmetic collectives)."""
+    shard_map = _shard_map()
+    from jax.sharding import PartitionSpec as P
+
+    def local(pkx, pky, mxa, mya, sig_part, ok_part, valid):
+        return _local_pair_verdict(
+            pkx, pky, mxa, mya, sig_part[0], ok_part[0], valid
+        )[None]
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P("sets"),) * 7, out_specs=P("sets"),
+    ))
+
+
+def stage_indexed_shards(shard_items, shard_cap: int, k_pad: int | None = None):
+    """Host stage of the shard-aware serving path: N fixed-shape sub-batches
+    (one per shard, each padded to ``shard_cap`` — padding per SHARD, not
+    per mesh) from lists of (indices, message, sig_bytes) triples.
+
+    Runs entirely on the host (SHA-256 hash_to_field, signature parsing,
+    index/mask packing, fresh RLC scalars) so the firehose prep thread can
+    stage batch N+1 while the device thread verifies batch N. Returns a dict
+    of numpy/jnp arrays at n_pad = len(shard_items) * shard_cap rows, shard
+    s owning rows [s*cap, (s+1)*cap)."""
+    from .serde import parse_g2_bytes
+    from ..ops.bls import h2c
+    from ..ops.bls_oracle.ciphersuite import DST
+
+    n_shards = len(shard_items)
+    n_pad = n_shards * shard_cap
+    k_pad = k_pad or bucket(
+        max((len(ix) for sh in shard_items for ix, _, _ in sh), default=1)
+    )
+    idx = np.zeros((n_pad, k_pad), dtype=np.int32)
+    mask = np.zeros((n_pad, k_pad), dtype=bool)
+    sig_bytes = np.zeros((n_pad, 96), dtype=np.uint8)
+    valid = np.zeros((n_pad,), dtype=bool)
+    msgs, rows = [], []
+    for s, sh in enumerate(shard_items):
+        if len(sh) > shard_cap:
+            raise ValueError(
+                f"shard {s} holds {len(sh)} items > cap {shard_cap}"
+            )
+        for j, (indices, msg, sb) in enumerate(sh):
+            r = s * shard_cap + j
+            k = len(indices)
+            if k > 0:
+                idx[r, :k] = np.asarray(indices, dtype=np.int32)
+                mask[r, :k] = True
+            sig_bytes[r] = np.frombuffer(sb, dtype=np.uint8)
+            valid[r] = True
+            msgs.append(msg)
+            rows.append(r)
+    parsed = parse_g2_bytes(sig_bytes)
+    sig_wf = parsed["wf_ok"] & ~parsed["is_inf"]
+    # hash only the real messages; padded rows broadcast the first real one
+    # (masked invalid — they only need to be SOME valid field element)
+    u_shape = (n_pad, 2, 25)
+    if msgs:
+        ur0, ur1 = h2c.hash_to_field_batch(msgs, DST)
+        ur0, ur1 = np.asarray(ur0), np.asarray(ur1)
+        u0 = np.broadcast_to(ur0[:1], u_shape).copy()
+        u1 = np.broadcast_to(ur1[:1], u_shape).copy()
+        u0[rows], u1[rows] = ur0, ur1
+    else:
+        u0 = np.zeros(u_shape, dtype=np.uint64)
+        u1 = np.zeros(u_shape, dtype=np.uint64)
+    scalars = np.array(
+        [secrets.randbits(RAND_BITS) or 1 for _ in range(n_pad)],
+        dtype=np.uint64,
+    )
+    return {
+        "n_pad": n_pad,
+        "k_pad": k_pad,
+        "idx": idx,
+        "mask": mask,
+        "u0": u0,
+        "u1": u1,
+        "x_c0": np.asarray(parsed["x_c0"]),
+        "x_c1": np.asarray(parsed["x_c1"]),
+        "s_flag": np.asarray(parsed["s_flag"]),
+        "sig_wf": np.asarray(sig_wf),
+        "scalars": scalars,
+        "valid": valid,
+    }
+
+
+_STAGED_SET_KEYS = (
+    "idx", "mask", "u0", "u1", "x_c0", "x_c1", "s_flag", "sig_wf",
+    "scalars", "valid",
+)
+
+
+def put_staged(staged: dict, mesh) -> dict:
+    """Move one staged sub-batch family onto the mesh, per-set arrays
+    sharded over the ``sets`` axis — one async H2D transfer per shard, so a
+    prep thread staging batch N+1 double-buffers against the device thread
+    verifying batch N (jax transfers are dispatched asynchronously)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("sets"))
+    out = dict(staged)
+    for k in _STAGED_SET_KEYS:
+        out[k] = jax.device_put(staged[k], sh)
+    return out
+
+
+def verify_staged_pershard(cache_arr, staged: dict, mesh) -> np.ndarray:
+    """Run the sharded serving pipeline (h2c / prep / per-shard verdict) on
+    a staged sub-batch family. Returns the [n_dev] per-shard verdict vector:
+    shard s's bool covers exactly its own ``shard_cap`` rows."""
+    n_pad, k_pad = staged["n_pad"], staged["k_pad"]
+    h2c_k = _sharded_h2c_stage(mesh, n_pad)
+    prep_k = _sharded_prep_stage(mesh, n_pad, k_pad)
+    verdict_k = _sharded_verdict_stage(mesh, n_pad)
+    mxa, mya = h2c_k(staged["u0"], staged["u1"])
+    pkx, pky, partial_sig, ok_parts = prep_k(
+        cache_arr, staged["idx"], staged["mask"], staged["x_c0"],
+        staged["x_c1"], staged["s_flag"], staged["sig_wf"],
+        staged["scalars"], staged["valid"],
+    )
+    oks = verdict_k(
+        pkx, pky, mxa, mya, partial_sig, ok_parts, staged["valid"]
+    )
+    return np.asarray(oks)
+
+
+def verify_indexed_shards_pershard(cache_arr, shard_items, mesh) -> np.ndarray:
+    """Per-shard-verdict verification of N per-shard sub-batches over the
+    mesh (stage + transfer + dispatch in one call — the non-pipelined
+    convenience used by tests and the degradation ladder's re-staging
+    rungs). ``shard_items``: one list of (indices, message, sig_bytes)
+    triples per device; sub-batches are padded per shard to a shared
+    power-of-two cap. Returns the [n_dev] verdict vector."""
+    n_dev = mesh.devices.size
+    if len(shard_items) != n_dev:
+        raise ValueError(f"{len(shard_items)} shards for a {n_dev}-device mesh")
+    cap = bucket(max((len(sh) for sh in shard_items), default=1))
+    staged = stage_indexed_shards(shard_items, cap)
+    staged = put_staged(staged, mesh)
+    return verify_staged_pershard(cache_arr, staged, mesh)
+
+
 def _sharded_verify_kernel(mesh, n_pad: int):
     """Multi-chip twin of ``_verify_kernel``: dp over signature sets on the
     mesh's ``sets`` axis, as three staged shard_map jits (array prologue /
@@ -613,6 +789,38 @@ def verify_signature_sets_sharded(
     valid = np.arange(n_pad) < n_real
     ok = _sharded_verify_kernel(mesh, n_pad)(
         pk_agg, sig, msg_x, msg_y, jnp.asarray(scalars), jnp.asarray(valid)
+    )
+    return bool(np.asarray(ok))
+
+
+def verify_signature_sets_sharded_h2c(pk_agg, sig, u0, u1, n_real: int,
+                                      mesh) -> bool:
+    """Sharded twin of ``verify_signature_sets_device_h2c`` — the generic
+    ``bls.verify_signature_sets`` seam's mesh path: device h2c + prologue +
+    Miller partials data-parallel over the ``sets`` axis, cross-device
+    G2-MSM / Fq12-product combine, ONE final exponentiation. Inputs may be
+    padded to any length ≥ n_real; they are re-padded to a mesh-multiple
+    bucket here (broadcast, masked invalid)."""
+    if n_real == 0:
+        return False
+    n_dev = mesh.devices.size
+    n = pk_agg.shape[0]
+    n_pad = ((bucket(max(n, n_dev)) + n_dev - 1) // n_dev) * n_dev
+    if n_pad != n:
+        def _pad(a):
+            return jnp.concatenate(
+                [a, jnp.broadcast_to(a[:1], (n_pad - n,) + a.shape[1:])]
+            )
+
+        pk_agg, sig, u0, u1 = map(_pad, (pk_agg, sig, u0, u1))
+    scalars = np.array(
+        [secrets.randbits(RAND_BITS) or 1 for _ in range(n_pad)],
+        dtype=np.uint64,
+    )
+    valid = np.arange(n_pad) < n_real
+    mx, my = _sharded_h2c_stage(mesh, n_pad)(u0, u1)
+    ok = _sharded_verify_kernel(mesh, n_pad)(
+        pk_agg, sig, mx, my, jnp.asarray(scalars), jnp.asarray(valid)
     )
     return bool(np.asarray(ok))
 

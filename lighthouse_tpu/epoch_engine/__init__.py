@@ -68,7 +68,7 @@ def device_backend_active() -> bool:
     return _accelerator_present()
 
 
-def maybe_process_epoch_on_device(spec, state) -> bool:
+def maybe_process_epoch_on_device(spec, state, sharding=None) -> bool:
     """The ``process_epoch`` seam: True when the device engine fully handled
     the epoch transition, False when the numpy path should run.
 
@@ -83,7 +83,7 @@ def maybe_process_epoch_on_device(spec, state) -> bool:
         return False
     from .engine import process_epoch_on_device
 
-    return process_epoch_on_device(spec, state)
+    return process_epoch_on_device(spec, state, sharding=sharding)
 
 
 def prepare_state(state, sharding=None):

@@ -22,7 +22,11 @@ package reproduces TPU-first:
     device calls instead of n per-set calls;
   * **attester/shuffling cache tier** (``attester_cache.py``) — committee
     resolution for gossip attestations off the full-state path
-    (``beacon_chain/src/attester_cache.rs`` / ``shuffling_cache.rs`` parity).
+    (``beacon_chain/src/attester_cache.rs`` / ``shuffling_cache.rs`` parity);
+  * **sharded serving tier** (``sharding.py``) — N fixed-shape sub-batches
+    per tick data-parallel over the device mesh with per-shard verdicts and
+    per-shard fault domains (mesh -> N/2 -> single -> CPU-oracle ladder),
+    behind the ``LIGHTHOUSE_MESH_DEVICES`` seam (``bls/mesh.py``).
 """
 
 from .attester_cache import (
@@ -33,6 +37,7 @@ from .attester_cache import (
 from .batcher import AdaptiveBatcher, FirehoseConfig, FirehoseItem
 from .bisect import bisect_verify
 from .engine import FirehoseEngine, FirehoseStats
+from .sharding import MeshVerifier, ShardPlan, plan_shards
 
 __all__ = [
     "AdaptiveBatcher",
@@ -41,7 +46,10 @@ __all__ = [
     "FirehoseEngine",
     "FirehoseItem",
     "FirehoseStats",
+    "MeshVerifier",
+    "ShardPlan",
     "ShufflingCache",
     "attester_shuffling_decision_slot",
     "bisect_verify",
+    "plan_shards",
 ]

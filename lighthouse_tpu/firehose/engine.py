@@ -93,6 +93,7 @@ class FirehoseEngine:
         synchronous: bool = False,
         supervisor=None,
         fallback_verify_fn=None,
+        shard_planner=None,
     ):
         self.config = config or FirehoseConfig()
         self.batcher = AdaptiveBatcher(self.config)
@@ -103,6 +104,14 @@ class FirehoseEngine:
         # with watchdog + classified retries instead of failing the batch
         self.supervisor = supervisor
         self.fallback_verify_fn = fallback_verify_fn
+        # optional sharded serving tier (firehose/sharding.MeshVerifier):
+        # the prep thread stages per-shard sub-batches + H2D transfers for
+        # batch N+1 while the device thread runs batch N over the mesh, and
+        # verdicts come back per SHARD — a poisoned shard bisects only its
+        # own groups. The planner carries its own fault-domain ladder
+        # (mesh -> shrunken mesh -> single device -> CPU oracle), so it is
+        # never combined with `supervisor` (that would double-wrap)
+        self.shard_planner = shard_planner
         self.synchronous = synchronous
         # callback(payload, ok, meta) used when submit() gives none
         self.default_callback = None
@@ -142,13 +151,24 @@ class FirehoseEngine:
     # -- pipeline stages ----------------------------------------------------------
 
     def _prep_batch(self, batch: list[FirehoseItem]):
-        """Host stage: payloads -> signature-set groups (or Exceptions)."""
+        """Host stage: payloads -> signature-set groups (or Exceptions).
+        With a shard planner attached, also stages the tick's per-shard
+        sub-batches + host->device transfers (so they double-buffer against
+        the device thread's in-flight verify)."""
         with self._stats_lock:
             self.batches_formed += 1
         FIREHOSE_BATCHES_FORMED.inc(work_type=batch[0].work_type.name)
         FIREHOSE_BATCH_FILL.observe(len(batch))
         groups = self.prepare_fn([it.payload for it in batch])
-        return batch, groups
+        staged = None
+        if self.shard_planner is not None:
+            real = [
+                g for g in groups
+                if not isinstance(g, Exception) and g[0]
+            ]
+            if real:
+                staged = self.shard_planner.stage([g for g, _ in real])
+        return batch, groups, staged
 
     def _supervised_verify(self, items) -> bool:
         """The device verify call, run through the fault domain when one is
@@ -173,9 +193,28 @@ class FirehoseEngine:
             )
         return self.supervisor.run_ladder("firehose.device_verify", rungs)
 
+    def _sharded_verdicts(self, groups, staged) -> dict[int, bool]:
+        """Mesh path: per-SHARD verdicts from the planner, then bisection
+        only among the groups of failed shards (a poisoned shard never
+        forces a whole-tick bisection)."""
+        per_group = self.shard_planner.verify_groups(groups, staged=staged)
+        verdicts = {i: ok for i, ok in enumerate(per_group) if ok}
+        bad = [i for i, ok in enumerate(per_group) if not ok]
+        if bad:
+            for i, ok in zip(
+                bad,
+                bisect_verify(
+                    [groups[i] for i in bad],
+                    self._supervised_verify,
+                    assume_failed=True,
+                ),
+            ):
+                verdicts[i] = ok
+        return verdicts
+
     def _verify_batch(self, prepped) -> None:
         """Device stage: batched verify, bisection on failure, callbacks."""
-        batch, entries = prepped
+        batch, entries, staged = prepped
         real = [
             (it, group, meta)
             for it, entry in zip(batch, entries)
@@ -190,8 +229,13 @@ class FirehoseEngine:
             # every item still gets its callback, counted as errored —
             # and the fault is classified + recorded, never dropped silently
             try:
-                flat = [item for _, group, _ in real for item in group]
-                if self._supervised_verify(flat):
+                if self.shard_planner is not None:
+                    verdicts = self._sharded_verdicts(
+                        [group for _, group, _ in real], staged
+                    )
+                elif self._supervised_verify(
+                    [item for _, group, _ in real for item in group]
+                ):
                     for i, _ in enumerate(real):
                         verdicts[i] = True
                 else:
@@ -279,7 +323,7 @@ class FirehoseEngine:
             except Exception as e:  # noqa: BLE001 — poison batch, keep pumping
                 # classified fault record instead of a silent poison
                 faults.record_fault("firehose.prep", e, domain="firehose")
-                prepped = (batch, [e] * len(batch))
+                prepped = (batch, [e] * len(batch), None)
             if not self._handoff(prepped):  # blocks at prep_depth: double buffer
                 return
 

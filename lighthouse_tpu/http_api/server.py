@@ -483,6 +483,15 @@ class BeaconApiServer:
         # one snapshot: a concurrent import swaps chain.head atomically, so
         # every field here must come from the SAME head view
         head = self.chain.head
+        # early-attester cache (early_attester_cache.rs): same-epoch
+        # attestations to the current head never touch (or slot-advance) a
+        # state — the validator-client stampede at the attestation deadline
+        # is served from six cached fields
+        cached = self.chain.early_attester_cache.try_attestation_data(
+            spec, slot, committee_index, head.root
+        )
+        if cached is not None:
+            return {"data": _hex(AttestationData.encode(cached))}
         state = head.state
         if state.slot < slot:
             state = state.copy()

@@ -230,6 +230,21 @@ FIREHOSE_SHUFFLING_CACHE = REGISTRY.counter(
     "Attester/shuffling cache tier lookups (hit / miss)",
     label_names=("result",),
 )
+EARLY_ATTESTER_CACHE = REGISTRY.counter(
+    "early_attester_cache_total",
+    "Head-block attestation-data cache lookups (hit / miss / evict)",
+    label_names=("result",),
+)
+MESH_ACTIVE_DEVICES = REGISTRY.gauge(
+    "mesh_active_devices",
+    "Devices serving the last sharded verification dispatch per mesh domain",
+    label_names=("domain",),
+)
+MESH_SHARD_VERDICTS = REGISTRY.counter(
+    "mesh_shard_verdicts_total",
+    "Per-shard verdicts from the sharded serving tier (ok / failed)",
+    label_names=("result",),
+)
 RESILIENCE_FAULTS = REGISTRY.counter(
     "resilience_faults_total",
     "Classified device-path faults (resilience/faults.py taxonomy)",

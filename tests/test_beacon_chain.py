@@ -162,3 +162,95 @@ class TestChain:
         assert not isinstance(results[0][1], Exception)
         clock.set_slot(3)
         assert chain.recompute_head() == target
+
+
+class TestEarlyAttesterCache:
+    """Head-block attestation data served without a state read
+    (beacon_chain/early_attester_cache.py; early_attester_cache.rs parity)."""
+
+    @staticmethod
+    def _state_path_data(chain, slot: int, index: int):
+        """The http_api state path, replicated verbatim: the reference the
+        cache must agree with byte-for-byte on every hit."""
+        from lighthouse_tpu.state_transition import (
+            get_block_root_at_slot,
+            process_slots,
+        )
+        from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+
+        spec = chain.spec
+        head = chain.head
+        state = head.state
+        if state.slot < slot:
+            state = state.copy()
+            process_slots(spec, state, slot)
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        if slot == spec.start_slot(epoch) and head.slot <= slot:
+            target_root = head.root
+        else:
+            target_root = get_block_root_at_slot(
+                spec, state, spec.start_slot(epoch)
+            )
+        return AttestationData(
+            slot=slot, index=index, beacon_block_root=head.root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def test_hit_is_byte_identical_to_state_path(self, chain_and_harness):
+        from lighthouse_tpu.types.containers import AttestationData
+
+        chain, h, clock = chain_and_harness
+        for slot in (1, 2):
+            clock.set_slot(slot)
+            block = h.produce_block(slot)
+            h.apply_block(block)
+            chain.process_block(block)
+        assert chain.early_attester_cache.stats()["primed"]
+        hits0 = chain.early_attester_cache.stats()["hits"]
+        # every same-epoch slot at/after the head serves from the cache,
+        # byte-identical to the full state path
+        epoch_end = chain.spec.preset.SLOTS_PER_EPOCH - 1
+        for slot in range(2, epoch_end + 1):
+            clock.set_slot(slot)
+            cached = chain.early_attester_cache.try_attestation_data(
+                chain.spec, slot, 0, chain.head.root
+            )
+            assert cached is not None, slot
+            assert AttestationData.encode(cached) == AttestationData.encode(
+                self._state_path_data(chain, slot, 0)
+            ), slot
+        assert chain.early_attester_cache.stats()["hits"] > hits0
+
+    def test_miss_on_stale_head_old_slot_or_next_epoch(self, chain_and_harness):
+        chain, h, clock = chain_and_harness
+        for slot in (1, 2):
+            clock.set_slot(slot)
+            block = h.produce_block(slot)
+            h.apply_block(block)
+            chain.process_block(block)
+        cache = chain.early_attester_cache
+        spec = chain.spec
+        # a different head root (competing fork / stale caller view)
+        assert cache.try_attestation_data(spec, 2, 0, b"\x11" * 32) is None
+        # a slot before the head (the head is not an ancestor there)
+        assert cache.try_attestation_data(spec, 1, 0, chain.head.root) is None
+        # epoch rollover: the entry is for the head's epoch only
+        nxt = spec.preset.SLOTS_PER_EPOCH
+        assert cache.try_attestation_data(spec, nxt, 0, chain.head.root) is None
+        misses = cache.stats()["misses"]
+        assert misses >= 3
+
+    def test_eviction(self, chain_and_harness):
+        chain, h, clock = chain_and_harness
+        clock.set_slot(1)
+        block = h.produce_block(1)
+        h.apply_block(block)
+        chain.process_block(block)
+        cache = chain.early_attester_cache
+        assert cache.stats()["primed"]
+        cache.evict()
+        assert not cache.stats()["primed"]
+        assert cache.try_attestation_data(
+            chain.spec, 1, 0, chain.head.root
+        ) is None

@@ -1,0 +1,466 @@
+"""Sharded serving tier: shard planner, per-shard fault domains, engine path.
+
+Host-side tier (no jax): the ``MeshVerifier``'s device work is injected as
+stubs, so the fault-domain ladder (mesh N -> N/2 -> single -> CPU oracle),
+the per-shard supervisors, and the engine's per-shard verdict/bisection
+path are exercised deterministically in milliseconds. The real mesh
+kernels are locked down in ``tests/test_multichip.py`` (native-shard_map
+boxes) and the sustained-load bench rung.
+"""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu import resilience
+from lighthouse_tpu.firehose import (
+    FirehoseConfig,
+    FirehoseEngine,
+    MeshVerifier,
+    plan_shards,
+)
+from lighthouse_tpu.resilience import SupervisedFault, injector
+
+
+@pytest.fixture(autouse=True)
+def clean_fault_domains():
+    injector.clear()
+    resilience.reset_all()
+    yield
+    injector.clear()
+    resilience.reset_all()
+
+
+def force_probation_due(sup):
+    """Make a QUARANTINED supervisor's probation immediately due (tests
+    must not sleep through the real cool-off)."""
+    with sup._lock:
+        if sup._quarantined_at is not None:
+            sup._quarantined_at = time.monotonic() - 3600.0
+
+
+# -- shard planner -----------------------------------------------------------------
+
+
+class TestPlanShards:
+    def test_groups_never_straddle_and_balance(self):
+        groups = [[1], [2, 3, 4], [5], [6, 7], [8], [9, 10, 11]]
+        p = plan_shards(groups, 4, cap_floor=1)
+        # every group is wholly inside its assigned shard
+        for g, s in zip(groups, p.group_shard):
+            for item in g:
+                assert item in p.shard_items[s]
+        # least-loaded assignment keeps the max fill at the 3-item groups
+        assert max(len(sh) for sh in p.shard_items) == 4
+        assert p.cap == 4  # power-of-two bucket of the max fill
+
+    def test_cap_floor_and_determinism(self):
+        groups = [[i] for i in range(5)]
+        p1 = plan_shards(groups, 8, cap_floor=4)
+        p2 = plan_shards(groups, 8, cap_floor=4)
+        assert p1.cap == 4
+        assert p1.group_shard == p2.group_shard == [0, 1, 2, 3, 4]
+
+    def test_empty(self):
+        p = plan_shards([], 8)
+        assert p.group_shard == [] and all(not s for s in p.shard_items)
+
+
+# -- MeshVerifier fault-domain ladder ----------------------------------------------
+
+
+class _Stub:
+    """Recording stub backend for the verifier: per-shard verdicts come
+    from ``bad_shards`` (device-index keyed), faults from ``raise_on``."""
+
+    def __init__(self):
+        self.dispatches = []
+        self.singles = []
+        self.oracles = []
+        self.bad_shards = set()
+        self.raise_on = set()   # device ids whose participation faults
+
+    def dispatch(self, shard_items, device_ids, staged=None, shard_cap=None):
+        self.dispatches.append((tuple(device_ids), staged is not None))
+        if set(device_ids) & self.raise_on:
+            raise RuntimeError("injected transient dispatch fault")
+        return [i not in self.bad_shards for i in device_ids]
+
+    def single(self, items):
+        self.singles.append(len(items))
+        return True
+
+    def oracle(self, items):
+        self.oracles.append(len(items))
+        return True
+
+
+def make_verifier(stub, n=8, **kw):
+    return MeshVerifier(
+        n,
+        dispatch_fn=stub.dispatch,
+        single_fn=stub.single,
+        oracle_fn=stub.oracle,
+        **kw,
+    )
+
+
+class TestMeshVerifier:
+    def test_happy_path_per_group_verdicts(self):
+        stub = _Stub()
+        mv = make_verifier(stub)
+        groups = [[(i,)] for i in range(10)]
+        assert mv.verify_groups(groups) == [True] * 10
+        assert stub.dispatches == [((0, 1, 2, 3, 4, 5, 6, 7), False)]
+
+    def test_failed_shard_maps_to_its_groups_only(self):
+        stub = _Stub()
+        stub.bad_shards = {2}
+        mv = make_verifier(stub)
+        groups = [[(i,)] for i in range(8)]  # group i -> shard i (balanced)
+        verdicts = mv.verify_groups(groups)
+        plan = plan_shards(groups, 8, cap_floor=mv.cap_floor)
+        expected = [plan.group_shard[g] != 2 for g in range(8)]
+        assert verdicts == expected and not all(verdicts)
+
+    def test_injected_shard_fault_shrinks_mesh_no_false_verifies(self):
+        """One faulted device -> the ladder serves the tick from the OTHER
+        aligned half-mesh block; the shard's supervisor demotes; verdicts
+        stay honest."""
+        stub = _Stub()
+        mv = make_verifier(stub)
+        injector.install("stage=mesh.shard3;mode=raise;kind=oom;every=1;times=2")
+        groups = [[(i,)] for i in range(10)]
+        assert mv.verify_groups(groups) == [True] * 10
+        # the serving dispatch excluded the faulted shard's block
+        assert stub.dispatches[-1][0] == (4, 5, 6, 7)
+        assert mv.shard_sups[3].snapshot()["state"] == "DEGRADED"
+        assert mv.mesh_sup.snapshot()["demotions"] >= 1
+
+    def test_faulted_shard_quarantines_then_repromotes(self):
+        stub = _Stub()
+        mv = make_verifier(stub)
+        injector.install("stage=mesh.shard3;mode=raise;kind=oom;every=1;times=2")
+        groups = [[(i,)] for i in range(10)]
+        assert all(mv.verify_groups(groups))
+        assert all(mv.verify_groups(groups))  # second fault -> quarantine
+        assert mv.shard_sups[3].snapshot()["state"] == "QUARANTINED"
+        assert 3 not in mv.healthy_indices()
+        # injection exhausted (times=2): force probations due and let the
+        # ladder probe its way back to the full mesh
+        for _ in range(10):
+            force_probation_due(mv.mesh_sup)
+            force_probation_due(mv.shard_sups[3])
+            assert all(mv.verify_groups(groups))
+        assert stub.dispatches[-1][0] == (0, 1, 2, 3, 4, 5, 6, 7)
+        assert mv.shard_sups[3].snapshot()["state"] == "HEALTHY"
+        # the demote AND the re-promotion are visible in the metrics
+        assert mv.shard_sups[3].snapshot()["promotions"] >= 1
+        assert mv.mesh_sup.snapshot()["promotions"] >= 1
+
+    def test_dispatch_fault_attributed_by_probe_excludes_shard(self):
+        """An unattributed mesh fault triggers per-device probes; the
+        faulted device demotes and the next rung's block avoids it."""
+        stub = _Stub()
+        stub.raise_on = {0}   # any mesh containing device 0 faults
+
+        def probe(device_id):
+            if device_id in stub.raise_on:
+                raise RuntimeError("device probe transient failure")
+
+        mv = make_verifier(stub, probe_fn=probe)
+        groups = [[(i,)] for i in range(6)]
+        assert mv.verify_groups(groups) == [True] * 6
+        # mesh8 faulted -> probes condemn device 0 -> mesh4 takes the
+        # OTHER aligned block
+        assert stub.dispatches[-1][0] == (4, 5, 6, 7)
+        assert mv.shard_sups[0].snapshot()["faults"] >= 1
+
+    def test_unattributed_fault_without_probe_reaches_single(self):
+        """No probe_fn: the ladder cannot tell which device faulted, so it
+        descends through the blocks and lands on the single-device rung —
+        still honest, never a false verify."""
+        stub = _Stub()
+        stub.raise_on = set(range(8))   # every mesh dispatch faults
+        mv = make_verifier(stub)
+        groups = [[(i,)] for i in range(6)]
+        assert mv.verify_groups(groups) == [True] * 6
+        assert stub.singles == [6]
+
+    def test_corruption_jumps_to_cpu_oracle(self):
+        stub = _Stub()
+        mv = make_verifier(stub)
+        injector.install("stage=mesh.shard1;mode=corrupt;at=1")
+        groups = [[(i,)] for i in range(4)]
+        assert mv.verify_groups(groups) == [True] * 4
+        # a corruption-classified fault must not trust ANY device rung
+        assert stub.oracles == [4]
+        assert stub.singles == []
+
+    def test_all_rungs_fault_fails_closed(self):
+        stub = _Stub()
+        stub.raise_on = set(range(8))
+
+        def bad_single(items):
+            raise RuntimeError("single device down")
+
+        mv = MeshVerifier(
+            8, dispatch_fn=stub.dispatch, single_fn=bad_single,
+            oracle_fn=None,
+        )
+        with pytest.raises(SupervisedFault):
+            mv.verify_groups([[(1,)], [(2,)]])
+        assert mv.mesh_sup.snapshot()["exhausted"] == 1
+
+    def test_verify_items_bool_contract(self):
+        stub = _Stub()
+        mv = make_verifier(stub)
+        assert mv.verify_items([(1,), (2,), (3,)]) is True
+        stub.bad_shards = {0}
+        assert mv.verify_items([(i,) for i in range(8)]) is False
+
+    def test_staged_fast_path_and_restage_on_shrink(self):
+        staged_calls = []
+
+        def stage(shard_items, device_ids, cap):
+            staged_calls.append(tuple(device_ids))
+            return {"cap": cap}
+
+        stub = _Stub()
+        mv = make_verifier(stub, stage_fn=stage)
+        groups = [[(i,)] for i in range(8)]
+        staged = mv.stage(groups)
+        assert staged is not None and staged_calls == [(0, 1, 2, 3, 4, 5, 6, 7)]
+        assert mv.verify_groups(groups, staged=staged) == [True] * 8
+        assert stub.dispatches[-1] == ((0, 1, 2, 3, 4, 5, 6, 7), True)
+        # a shrunken mesh cannot reuse full-mesh staging: it re-stages inline
+        injector.install("stage=mesh.shard0;mode=raise;kind=oom;every=1;times=1")
+        staged = mv.stage(groups)
+        assert mv.verify_groups(groups, staged=staged) == [True] * 8
+        assert stub.dispatches[-1] == ((4, 5, 6, 7), False)
+
+
+# -- engine + shard planner --------------------------------------------------------
+
+
+class TestEngineShardPath:
+    def _engine(self, mv, verify_items, max_batch=16):
+        return FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], f"m{p}") for p in ps],
+            verify_items_fn=verify_items,
+            config=FirehoseConfig(max_batch=max_batch),
+            synchronous=True,
+            shard_planner=mv,
+        )
+
+    def test_per_shard_verdicts_bisect_only_failed_shards(self):
+        """Groups in healthy shards verify WITHOUT any bisection call; only
+        the failed shard's groups re-verify."""
+        bad_payloads = {3, 6}
+        bisect_calls = []
+
+        def dispatch(shard_items, device_ids, staged=None, shard_cap=None):
+            return [
+                not any(it[0] in bad_payloads for it in sh)
+                for sh in shard_items
+            ]
+
+        def verify_items(items):
+            bisect_calls.append([it[0] for it in items])
+            return not any(it[0] in bad_payloads for it in items)
+
+        mv = MeshVerifier(8, dispatch_fn=dispatch, single_fn=None,
+                          oracle_fn=None)
+        engine = self._engine(mv, verify_items)
+        verdicts = {}
+        for i in range(8):
+            engine.submit(i, callback=lambda p, ok, m: verdicts.__setitem__(p, ok))
+        engine.drain()
+        assert verdicts == {i: i not in bad_payloads for i in range(8)}
+        st = engine.stats()
+        assert st.verified == 6 and st.rejected == 2 and st.errored == 0
+        # bisection touched only the failed shards' groups — groups that
+        # verified at the shard level never re-verify
+        flat_bisected = {p for call in bisect_calls for p in call}
+        assert flat_bisected <= bad_payloads
+
+    def test_planner_fault_fails_batch_closed(self):
+        def dispatch(shard_items, device_ids, staged=None, shard_cap=None):
+            raise RuntimeError("mesh down")
+
+        mv = MeshVerifier(4, dispatch_fn=dispatch, single_fn=None,
+                          oracle_fn=None)
+        engine = self._engine(mv, lambda items: True)
+        verdicts = {}
+        for i in range(4):
+            engine.submit(i, callback=lambda p, ok, m: verdicts.__setitem__(p, ok))
+        engine.drain()
+        assert verdicts == {i: False for i in range(4)}
+        st = engine.stats()
+        assert st.errored == 4 and st.verified == 0 and st.device_faults == 1
+
+    def test_threaded_engine_stages_on_prep_thread(self):
+        """With a stage_fn, the prep thread stages the tick and the device
+        thread dispatches the STAGED arrays (the H2D double buffer)."""
+        stage_threads, dispatch_staged = [], []
+        done = threading.Event()
+
+        def stage(shard_items, device_ids, cap):
+            stage_threads.append(threading.current_thread().name)
+            return {"cap": cap}
+
+        def dispatch(shard_items, device_ids, staged=None, shard_cap=None):
+            dispatch_staged.append(staged is not None)
+            if len(dispatch_staged) >= 2:
+                done.set()
+            return [True] * len(device_ids)
+
+        mv = MeshVerifier(4, dispatch_fn=dispatch, stage_fn=stage,
+                          single_fn=None, oracle_fn=None)
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], None) for p in ps],
+            verify_items_fn=lambda items: True,
+            config=FirehoseConfig(max_batch=4, deadline_s=0.001),
+            shard_planner=mv,
+        )
+        for i in range(8):
+            engine.submit(i)
+        done.wait(5.0)
+        engine.stop(drain_timeout=10.0)
+        assert engine.stats().verified == 8
+        assert all(t.startswith("firehose-prep") for t in stage_threads)
+        assert dispatch_staged and all(dispatch_staged)
+
+
+# -- chaos: seeded gossip loss + periodic shard fault + tampering -----------------
+
+
+@pytest.mark.chaos
+class TestShardedChaos:
+    def test_seeded_loss_shard_faults_and_tampering(self):
+        """A seeded lossy stream with a periodically faulting device and
+        tampered payloads through the sharded engine: zero false verifies,
+        drop rate within SLO, demotion AND re-promotion visible."""
+        import random
+
+        rng = random.Random(0xC7A05)
+        tampered = {i for i in range(200) if i % 17 == 0}
+
+        def dispatch(shard_items, device_ids, staged=None, shard_cap=None):
+            return [
+                not any(it[0] in tampered for it in sh)
+                for sh in shard_items
+            ]
+
+        def verify_items(items):
+            return not any(it[0] in tampered for it in items)
+
+        mv = MeshVerifier(
+            8, dispatch_fn=dispatch, single_fn=verify_items,
+            oracle_fn=verify_items,
+        )
+        injector.install("stage=mesh.shard5;mode=raise;kind=oom;every=5;times=4")
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], None) for p in ps],
+            verify_items_fn=verify_items,
+            config=FirehoseConfig(max_batch=32, intake_capacity=64),
+            synchronous=True,
+            shard_planner=mv,
+        )
+        verdicts = {}
+        offered = dropped_by_loss = 0
+        for i in range(200):
+            offered += 1
+            if rng.random() < 0.02:   # seeded gossip loss upstream
+                dropped_by_loss += 1
+                continue
+            engine.submit(
+                i, callback=lambda p, ok, m: verdicts.__setitem__(p, ok)
+            )
+            if i % 32 == 31:
+                engine.drain()
+                force_probation_due(mv.mesh_sup)
+                force_probation_due(mv.shard_sups[5])
+        engine.drain()
+        # zero false verifies: every tampered payload that got a verdict is
+        # False; every clean one that got a verdict is True
+        for p, ok in verdicts.items():
+            assert ok == (p not in tampered), (p, ok)
+        st = engine.stats()
+        drop_rate = (st.dropped + dropped_by_loss) / offered
+        assert drop_rate <= 0.05, drop_rate
+        shard5 = mv.shard_sups[5].snapshot()
+        assert shard5["demotions"] >= 1
+        # injection exhausted mid-run: a few clean ticks finish walking the
+        # shard back up the promotion ladder to HEALTHY
+        for j in range(24):
+            force_probation_due(mv.mesh_sup)
+            force_probation_due(mv.shard_sups[5])
+            assert all(mv.verify_groups([[(1000 + j,)] for _ in range(8)]))
+        shard5 = mv.shard_sups[5].snapshot()
+        assert shard5["state"] == "HEALTHY" and shard5["promotions"] >= 1
+        assert st.verified + st.rejected + st.errored == len(verdicts)
+        assert st.rejected >= len(
+            [p for p in verdicts if p in tampered]
+        ) - st.errored
+
+
+# -- chain seam --------------------------------------------------------------------
+
+
+class TestChainMeshSeam:
+    """The backend seam: LIGHTHOUSE_MESH_DEVICES off -> the single-device
+    path is untouched (bit-identical); on -> _batch_verify_items routes
+    through the MeshVerifier ladder."""
+
+    @pytest.fixture()
+    def chain(self):
+        from lighthouse_tpu.beacon_chain import BeaconChain
+        from lighthouse_tpu.testing import StateHarness
+        from lighthouse_tpu.types.spec import minimal_spec
+        from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+        spec = minimal_spec()
+        h = StateHarness(spec, 16)
+        return BeaconChain(spec, h.state.copy(), slot_clock=ManualSlotClock(0))
+
+    def test_mesh_off_means_no_planner(self, chain, monkeypatch):
+        monkeypatch.delenv("LIGHTHOUSE_MESH_DEVICES", raising=False)
+        assert chain._mesh_planner() is None
+
+    def test_non_tpu_backend_never_builds_a_mesh(self, chain, monkeypatch):
+        from lighthouse_tpu import bls
+
+        monkeypatch.setenv("LIGHTHOUSE_MESH_DEVICES", "8")
+        prev = bls.get_backend()
+        bls.set_backend("native")
+        try:
+            assert chain._mesh_planner() is None
+        finally:
+            bls.set_backend(prev)
+
+    def test_mesh_on_routes_batch_verify_through_verifier(
+        self, chain, monkeypatch
+    ):
+        from lighthouse_tpu import bls
+
+        monkeypatch.setenv("LIGHTHOUSE_MESH_DEVICES", "8")
+        assert bls.get_backend() == "tpu"
+        mv = chain._mesh_planner()
+        assert mv is not None and mv.n_devices == 8  # conftest's CPU mesh
+        dispatches = []
+
+        def stub_dispatch(shard_items, device_ids, staged=None,
+                          shard_cap=None):
+            dispatches.append(tuple(device_ids))
+            return [True] * len(device_ids)
+
+        mv.dispatch_fn = stub_dispatch
+        items = [([0], b"\x22" * 32, b"\x99" * 96), ([1], b"\x33" * 32,
+                                                     b"\x88" * 96)]
+        assert chain._batch_verify_items(items) is True
+        assert dispatches == [(0, 1, 2, 3, 4, 5, 6, 7)]
+        # the firehose built on this chain shares the same planner
+        engine = chain.create_firehose(synchronous=True)
+        assert engine.shard_planner is mv

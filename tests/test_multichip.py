@@ -131,6 +131,148 @@ def test_sharded_gather_agrees_with_single_chip(mesh, indexed_fixture):
         verify_indexed_sets_device(cache, items)
 
 
+# ---------------------------------------------------------------------------
+# Per-shard-verdict serving path (the multi-chip firehose tier, ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def _as_shards(items, n_shards):
+    per = len(items) // n_shards
+    return [items[i * per:(i + 1) * per] for i in range(n_shards)]
+
+
+class TestPerShardVerdicts:
+    def test_valid_batch_all_shards_verify(self, mesh, indexed_fixture):
+        from lighthouse_tpu.bls.tpu_backend import (
+            verify_indexed_shards_pershard,
+        )
+
+        cache, items = indexed_fixture
+        oks = verify_indexed_shards_pershard(cache, _as_shards(items, 8), mesh)
+        assert oks.shape == (8,) and oks.all(), oks
+
+    def test_poison_condemns_only_its_shard_and_matches_single_device(
+        self, mesh, indexed_fixture
+    ):
+        """Shard-count parity: the per-shard verdict vector over 8 devices
+        must be BIT-IDENTICAL to verifying each sub-batch alone on one
+        device — including which shard a poisoned set condemns."""
+        from lighthouse_tpu.bls.tpu_backend import (
+            verify_indexed_sets_device,
+            verify_indexed_shards_pershard,
+        )
+
+        cache, items = indexed_fixture
+        poisoned = list(items)
+        ix, msg, _ = poisoned[11]
+        poisoned[11] = (ix, msg, poisoned[0][2])  # wrong signature
+        shards = _as_shards(poisoned, 8)
+        oks = verify_indexed_shards_pershard(cache, shards, mesh)
+        bad_shard = 11 // 2  # 2 items per shard
+        assert not oks[bad_shard]
+        for s, sh in enumerate(shards):
+            assert bool(oks[s]) == verify_indexed_sets_device(cache, sh), s
+
+    def test_empty_shards_fail_closed_without_poisoning_others(
+        self, mesh, indexed_fixture
+    ):
+        from lighthouse_tpu.bls.tpu_backend import (
+            verify_indexed_shards_pershard,
+        )
+
+        cache, items = indexed_fixture
+        shards = [items[:2]] + [[] for _ in range(7)]
+        oks = verify_indexed_shards_pershard(cache, shards, mesh)
+        assert bool(oks[0]) and not oks[1:].any()
+
+    def test_aggregate_3set_groups_parity_with_per_set(self, mesh):
+        """Aggregate-shaped 3-set groups through the sharded engine agree
+        with per-set verification (the satellite's parity check): group
+        atomicity means a shard verdict covers whole groups, and each
+        shard's verdict equals the AND of its groups' per-set verdicts."""
+        from __graft_entry__ import _indexed_fixture
+        from lighthouse_tpu.bls.tpu_backend import (
+            verify_indexed_sets_device,
+            verify_indexed_shards_pershard,
+        )
+        from lighthouse_tpu.firehose.sharding import plan_shards
+
+        cache, items = _indexed_fixture(24, n_validators=24)
+        groups = [items[3 * g:3 * g + 3] for g in range(8)]  # 3-set groups
+        # tamper one set of group 5 (its whole group must condemn)
+        ix, msg, _ = groups[5][1]
+        groups[5][1] = (ix, msg, groups[0][0][2])
+        plan = plan_shards(groups, 8, cap_floor=4)
+        oks = verify_indexed_shards_pershard(cache, plan.shard_items, mesh)
+        for g, grp in enumerate(groups):
+            shard_ok = bool(oks[plan.group_shard[g]])
+            per_set = all(
+                verify_indexed_sets_device(cache, [it]) for it in grp
+            )
+            assert shard_ok == per_set, (g, shard_ok, per_set)
+
+    def test_sharded_submit_loop_zero_steady_state_recompiles(
+        self, mesh, indexed_fixture
+    ):
+        """The recompile sentinel over the sharded stage/put/verify loop:
+        fixed per-shard shapes mean the steady-state serving tick never
+        recompiles (the satellite's sentinel rung)."""
+        from lighthouse_tpu.analysis.recompile import steady_state_compiles
+        from lighthouse_tpu.bls import tpu_backend as tb
+
+        cache, items = indexed_fixture
+        shards = _as_shards(items, 8)
+        cap = tb.bucket(max(len(s) for s in shards))
+
+        def step():
+            staged = tb.stage_indexed_shards(shards, cap)
+            staged = tb.put_staged(staged, mesh)
+            oks = tb.verify_staged_pershard(cache, staged, mesh)
+            assert oks.all()
+
+        names = steady_state_compiles(step, warmup=1, steps=3)
+        assert names == [], names
+
+
+class TestGenericSeamMeshPath:
+    """LIGHTHOUSE_MESH_DEVICES routes the generic ``bls.verify_signature_sets``
+    seam over the mesh; verdicts agree with the single-device path."""
+
+    @pytest.fixture()
+    def sets(self):
+        import hashlib
+
+        from lighthouse_tpu import bls
+
+        sk = bls.SecretKey.from_bytes((11).to_bytes(32, "big"))
+        pk = sk.public_key()
+        msgs = [
+            hashlib.sha256(b"mesh-seam-%02d" % i).digest() for i in range(3)
+        ]
+        return [
+            bls.SignatureSet.single_pubkey(sk.sign(m), pk, m) for m in msgs
+        ]
+
+    def test_seam_parity_valid_and_tampered(self, sets, monkeypatch):
+        from lighthouse_tpu import bls
+
+        assert bls.get_backend() == "tpu"
+        monkeypatch.delenv("LIGHTHOUSE_MESH_DEVICES", raising=False)
+        assert bls.verify_signature_sets(sets) is True
+        monkeypatch.setenv("LIGHTHOUSE_MESH_DEVICES", "8")
+        assert bls.verify_signature_sets(sets) is True
+        tampered = [
+            bls.SignatureSet.single_pubkey(
+                bls.Signature(sets[1].signature.point),  # wrong msg's sig
+                sets[0].signing_keys[0],
+                sets[0].message,
+            )
+        ] + sets[1:]
+        assert bls.verify_signature_sets(tampered) is False
+        monkeypatch.delenv("LIGHTHOUSE_MESH_DEVICES", raising=False)
+        assert bls.verify_signature_sets(tampered) is False
+
+
 @pytest.mark.slow  # two extra cold compiles (~7 min); nightly tier
 def test_sharded_gather_per_device_work_drops_with_mesh_size():
     """The SPMD module's per-device FLOPs must shrink as the mesh grows at

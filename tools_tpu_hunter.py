@@ -52,7 +52,11 @@ LOG = os.path.join(ROOT, "TPU_WINDOW_LOG.jsonl")
 STATE = os.path.join(CACHE, "hunter_state.json")
 RECORD = os.path.join(CACHE, "tpu_record.json")
 RECORD_FIREHOSE = os.path.join(CACHE, "tpu_firehose_record.json")
+RECORD_FIREHOSE_SHARDED = os.path.join(
+    CACHE, "tpu_firehose_sharded_record.json"
+)
 RECORD_EPOCH = os.path.join(CACHE, "tpu_epoch_record.json")
+RECORD_EPOCH_SHARDED = os.path.join(CACHE, "tpu_epoch_sharded_record.json")
 RECORD_H2C = os.path.join(CACHE, "tpu_h2c_record.json")
 RECORD_PAIRING = os.path.join(CACHE, "tpu_pairing_record.json")
 RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
@@ -82,12 +86,20 @@ RUNGS.insert(
     + bench._FIREHOSE_RUNG[5:],
 )
 RUNGS.insert(2, bench._EPOCH_RUNG_SMALL)
+# sharded serving-tier rungs (ISSUE 10): the multi-chip firehose A/B and
+# the 32k sharded epoch sweep ride mid-ladder (their mesh programs persist
+# in .jax_cache like everything else); the 1M sharded epoch is the final
+# stretch rung. Like every rung these start only behind the bench-main
+# flock marker check in main().
+RUNGS.insert(3, bench._FIREHOSE_SHARDED_RUNG)
+RUNGS.insert(4, bench._EPOCH_SHARDED_RUNG_SMALL)
 # h2c + pairing micro-rungs (smallest programs of the ladder — compile-warm
 # via .jax_cache): isolated hash-to-curve points/s and Miller/final-exp
 # pairing sets/s, each with per-stage chain timings and in-rung oracle parity
 RUNGS.insert(1, bench._PAIRING_RUNG_SMALL)
 RUNGS.insert(1, bench._H2C_RUNG_SMALL)
 RUNGS.append(bench._EPOCH_RUNG_FULL)
+RUNGS.append(bench._EPOCH_SHARDED_RUNG_FULL)
 
 
 def log(event: str, **kw) -> None:
@@ -227,13 +239,19 @@ def persist(rec: dict, rung_idx: int) -> None:
         f.write(json.dumps(rec) + "\n")
     # firehose/epoch records live in their own best-record files (different
     # metrics; bench.py --firehose/--epoch emit them when the end-of-round
-    # tunnel is wedged)
+    # tunnel is wedged). Sharded variants share a metric name with their
+    # single-device rung, so the mesh stamp picks the file — a mesh record
+    # must never shadow the single-device A/B baseline record (or vice versa)
+    sharded = bool(rec.get("sharded")) or (rec.get("n_devices") or 1) > 1
     record_path = {
-        "firehose_attestations_verified_per_s": RECORD_FIREHOSE,
-        "epoch_validators_per_s": RECORD_EPOCH,
-        "h2c_points_per_s": RECORD_H2C,
-        "pairing_sets_per_s": RECORD_PAIRING,
-    }.get(rec.get("metric"), RECORD)
+        ("firehose_attestations_verified_per_s", False): RECORD_FIREHOSE,
+        ("firehose_attestations_verified_per_s", True):
+            RECORD_FIREHOSE_SHARDED,
+        ("epoch_validators_per_s", False): RECORD_EPOCH,
+        ("epoch_validators_per_s", True): RECORD_EPOCH_SHARDED,
+        ("h2c_points_per_s", False): RECORD_H2C,
+        ("pairing_sets_per_s", False): RECORD_PAIRING,
+    }.get((rec.get("metric"), sharded), RECORD)
     best = None
     try:
         with open(record_path) as f:
