@@ -1344,6 +1344,156 @@ def _inner_epoch():
     )
 
 
+def _inner_slasher():
+    """Slasher-engine rung: whole-network slashable-behavior surveillance
+    as one batched matrix sweep (lighthouse_tpu/slasher/engine.py). Drives
+    the device-resident span store with mainnet-cadence honest traffic —
+    every tick, ``pairs`` validators vote (cur-1, cur); the window rolls
+    forward every ``ticks_per_epoch`` ticks INSIDE the jitted sweep — and
+    reports ``slashable_checks_per_s`` (pair-checks swept per second). The
+    numpy twin at the same shape is the baseline (skipped at 1M, where the
+    whole-plane host scatter+scan alone is minutes). A final untimed tick
+    carries seeded injected double/surround votes: the record proves 100%
+    candidate detection and zero false positives over the honest stream,
+    and the resilience stamp + span-store mode prove a numpy-demoted run
+    cannot masquerade as a device record."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    if fallback:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from lighthouse_tpu import slasher as slasher_pkg
+    from lighthouse_tpu.slasher.engine import SpanStore, validator_sharding
+
+    n = N_VALIDATORS
+    history = int(os.environ.get("BENCH_SLASHER_HISTORY", "64"))
+    pairs = int(os.environ.get("BENCH_SLASHER_PAIRS", str(min(n, 16384))))
+    iters = int(os.environ.get("BENCH_SLASHER_TICKS", "16"))
+    ticks_per_epoch = 4
+    platform = jax.devices()[0].platform
+    sharding = validator_sharding()
+    n_dev = 1
+    if sharding is not None:
+        n_dev = int(np.prod(tuple(sharding.mesh.shape.values())))
+    rng = np.random.default_rng(0x51A5)
+
+    def honest_tick(t):
+        cur = 100 + t // ticks_per_epoch
+        vidx = rng.choice(n, size=pairs, replace=False).astype(np.int64)
+        src = np.full(pairs, cur - 1, dtype=np.int64)
+        tgt = np.full(pairs, cur, dtype=np.int64)
+        vh = np.ones(pairs, dtype=np.uint32)
+        return vidx, src, tgt, vh, cur
+
+    def run(store, record_flags):
+        false_pos = 0
+        t0 = time.perf_counter()
+        for t in range(iters):
+            vidx, src, tgt, vh, cur = honest_tick(t)
+            res = store.apply(vidx, src, tgt, vh, cur)
+            if record_flags:
+                false_pos += int(
+                    res["min_flag"].sum() + res["max_flag"].sum()
+                    + res["dbl_flag"].sum()
+                )
+        return time.perf_counter() - t0, false_pos
+
+    # the rung measures the DEVICE engine (the numpy twin is the baseline
+    # below); a wedged-tunnel fallback still jits, pinned to JAX:cpu
+    slasher_pkg.set_backend("device")
+    store = SpanStore(history, sharding=sharding)
+    store.ensure_capacity(n)
+    t0 = time.perf_counter()
+    run_warm = honest_tick(0)
+    store.apply(*run_warm[:4], run_warm[4])  # bind planes + compile
+    print(
+        f"# warmup (bind + compile) {time.perf_counter() - t0:.0f}s on "
+        f"{platform} ({n}x{history} planes, {pairs} pairs/tick)",
+        flush=True,
+    )
+    dt, false_pos = run(store, record_flags=True)
+    value = pairs * iters / dt if dt else 0.0
+
+    # seeded slashable votes in one untimed tick: 8 validators vote
+    # (cur-2, cur-1); the first 4 then also vote (cur-3, cur), which
+    # SURROUNDS their (cur-2, cur-1) vote — 4 expected surround flags
+    cur = 100 + iters // ticks_per_epoch + 1
+    inj_v = rng.choice(n, size=8, replace=False).astype(np.int64)
+    vidx = np.concatenate([inj_v, inj_v[:4]])
+    src = np.concatenate(
+        [np.full(8, cur - 2, np.int64), np.full(4, cur - 3, np.int64)]
+    )
+    tgt = np.concatenate(
+        [np.full(8, cur - 1, np.int64), np.full(4, cur, np.int64)]
+    )
+    vh = np.concatenate([np.ones(8, np.uint32), np.full(4, 2, np.uint32)])
+    res = store.apply(vidx, src, tgt, vh, cur)
+    flagged_surround = int(res["min_flag"][8:].sum())
+    # doubles: 4 validators also re-vote target cur-1 with a different tag
+    vidx2, src2 = inj_v[4:], np.full(4, cur - 2, np.int64)
+    tgt2, vh2 = np.full(4, cur - 1, np.int64), np.full(4, 3, np.uint32)
+    res2 = store.apply(vidx2, src2, tgt2, vh2, cur)
+    flagged_double = int(res2["dbl_flag"].sum())
+
+    # numpy twin baseline at the same shape (prohibitive at 1M)
+    numpy_c_per_s = None
+    if n <= 262144:
+        rng = np.random.default_rng(0x51A5)
+        twin = SpanStore(history, use_device=False)
+        twin.ensure_capacity(n)
+        warm = honest_tick(0)
+        twin.apply(*warm[:4], warm[4])
+        twin_dt, _ = run(twin, record_flags=False)
+        numpy_c_per_s = pairs * iters / twin_dt if twin_dt else None
+
+    stats = store.stats()
+    print(
+        json.dumps(
+            {
+                "metric": "slashable_checks_per_s",
+                "value": round(value, 2),
+                "unit": "checks/s",
+                "vs_baseline": (
+                    round(value / numpy_c_per_s, 3) if numpy_c_per_s else None
+                ),
+                "platform": platform,
+                "fallback": fallback,
+                "n_devices": n_dev,
+                "sharded": sharding is not None,
+                "shape": {
+                    "validators": n,
+                    "history_length": history,
+                    "pairs_per_tick": pairs,
+                    "ticks_timed": iters,
+                },
+                "ms_per_tick": round(dt / iters * 1e3, 3) if iters else None,
+                "numpy_checks_per_s": (
+                    round(numpy_c_per_s, 2) if numpy_c_per_s else None
+                ),
+                "detection": {
+                    "injected_surround": 4,
+                    "flagged_surround": flagged_surround,
+                    "injected_double": 4,
+                    "flagged_double": flagged_double,
+                    "false_positives": false_pos,
+                },
+                # integrity stamp: a numpy-demoted run carries mode=host /
+                # demotions>0 here and degraded=true in the resilience block
+                "slasher_backend": stats["backend"],
+                "slasher_mode": stats["mode"],
+                "device_integrity": (
+                    stats["backend"] == "device" and stats["demotions"] == 0
+                ),
+                "span": stats,
+                "resilience": _resilience_summary(),
+            }
+        )
+    )
+
+
 # Shape ladder: (sets, keys, validators, batch, timeout_s). The first entry
 # is the mainnet shape (BASELINE.json config #4); smaller rungs bound a
 # pathological device compile (observed: the tunnel's server-side compile of
@@ -1391,6 +1541,17 @@ _EPOCH_LADDER = [
 _EPOCH_RUNG_SMALL = (0, 0, 32768, 0, 1350.0, "epoch")
 _EPOCH_RUNG_FULL = (0, 0, 1048576, 0, 4050.0, "epoch")
 
+# Slasher-engine ladder (ISSUE 11): (validators, timeout_s), largest first
+# like _EPOCH_LADDER. Only the validator count matters; history / pairs /
+# ticks come from BENCH_SLASHER_* env (defaults 64 / 16384 / 16).
+_SLASHER_LADDER = [
+    (1048576, 2700.0),
+    (262144, 1500.0),
+    (32768, 900.0),
+]
+_SLASHER_RUNG_SMALL = (0, 0, 32768, 0, 1350.0, "slasher")
+_SLASHER_RUNG_FULL = (0, 0, 1048576, 0, 4050.0, "slasher")
+
 # h2c micro-rung (the scalar-chain stage in isolation): only `batch`
 # matters. The small batch is the gossip shape; its program is tiny next to
 # the full verify kernels, so it stays compile-warm in .jax_cache and a
@@ -1433,6 +1594,7 @@ def _hunter_record(mode: str = "sets") -> dict | None:
         "epoch_sharded": "tpu_epoch_sharded_record.json",
         "h2c": "tpu_h2c_record.json",
         "pairing": "tpu_pairing_record.json",
+        "slasher": "tpu_slasher_record.json",
     }.get(mode, "tpu_record.json")
     path = os.path.join(_CACHE_DIR, name)
     try:
@@ -1501,6 +1663,8 @@ def main():
         mode = "epoch_sharded"
     elif "--epoch" in sys.argv:
         mode = "epoch"
+    elif "--slasher" in sys.argv:
+        mode = "slasher"
     elif "--h2c" in sys.argv:
         mode = "h2c"
     elif "--pairing" in sys.argv:
@@ -1513,6 +1677,8 @@ def main():
             _inner_firehose_sharded()
         elif inner_mode in ("epoch", "epoch_sharded"):
             _inner_epoch()
+        elif inner_mode == "slasher":
+            _inner_slasher()
         elif inner_mode == "h2c":
             _inner_h2c()
         elif inner_mode == "pairing":
@@ -1566,6 +1732,15 @@ def _main_measure(mode: str) -> None:
             ladder = [(128, 1, 2048, 16, 2700.0)]
     elif mode == "epoch_sharded":
         ladder = [(0, 0, v, 0, t) for v, t in _EPOCH_SHARDED_LADDER]
+        if "BENCH_VALIDATORS" in os.environ:
+            ladder = [
+                (0, 0, N_VALIDATORS, 0,
+                 float(os.environ.get("BENCH_TIMEOUT", "1350"))),
+            ]
+        elif fallback:
+            ladder = ladder[-1:]
+    elif mode == "slasher":
+        ladder = [(0, 0, v, 0, t) for v, t in _SLASHER_LADDER]
         if "BENCH_VALIDATORS" in os.environ:
             ladder = [
                 (0, 0, N_VALIDATORS, 0,
@@ -1627,6 +1802,7 @@ def _main_measure(mode: str) -> None:
         "epoch_sharded": "epoch_validators_per_s",
         "h2c": "h2c_points_per_s",
         "pairing": "pairing_sets_per_s",
+        "slasher": "slashable_checks_per_s",
     }.get(mode, "bls_attestation_sets_verified_per_s")
     print(
         json.dumps(
@@ -1638,6 +1814,7 @@ def _main_measure(mode: str) -> None:
                     "epoch": "validators/s",
                     "epoch_sharded": "validators/s",
                     "h2c": "points/s", "pairing": "sets/s",
+                    "slasher": "checks/s",
                 }.get(mode, "sets/s"),
                 "vs_baseline": 0.0,
                 "platform": platform,

@@ -261,7 +261,32 @@ def graph_registry(batch: int) -> list[tuple]:
              jax.ShapeDtypeStruct((), jnp.bool_),            # ok_part
              jax.ShapeDtypeStruct(B, jnp.bool_),             # valid
          )),
+        # slasher/kernels.py — the whole-registry surveillance sweep
+        # (ISSUE 11): window roll + scatter + directional scans + candidate
+        # flags over the span planes. Its obligations (u16 distance width,
+        # int32 target-domain headroom under MAX_EPOCH, window width within
+        # the distance encoding) are recorded by the kernel's own trace-time
+        # `fq._cert` calls.
+        ("slasher.sweep", _slasher_sweep_graph(),
+         (
+             jax.ShapeDtypeStruct((256, 64), jnp.uint16),    # min_d
+             jax.ShapeDtypeStruct((256, 64), jnp.uint16),    # max_d
+             jax.ShapeDtypeStruct((256, 64), jnp.uint32),    # vote_h
+             jax.ShapeDtypeStruct((), jnp.int32),            # delta
+             jax.ShapeDtypeStruct((batch * 4,), jnp.int32),  # vidx
+             jax.ShapeDtypeStruct((batch * 4,), jnp.int32),  # src
+             jax.ShapeDtypeStruct((batch * 4,), jnp.int32),  # tgt
+             jax.ShapeDtypeStruct((batch * 4,), jnp.uint32), # vote tags
+             jax.ShapeDtypeStruct((batch * 4,), jnp.bool_),  # valid
+             jax.ShapeDtypeStruct((), jnp.int32),            # cur epoch
+         )),
     ]
+
+
+def _slasher_sweep_graph():
+    from ..slasher import kernels as slasher_kernels
+
+    return functools.partial(slasher_kernels.sweep_impl, n=64)
 
 
 # Batch regimes: bound propagation is shape-dependent (broadcast axes reach

@@ -376,9 +376,12 @@ class ClientBuilder:
 
         slasher_service = None
         if cfg.slasher_enabled:
-            from ..slasher import Slasher, SlasherService
+            from ..slasher import SlasherService, make_slasher
 
-            slasher = Slasher(store.hot, chain.ns)
+            # the engine-backed slasher behind LIGHTHOUSE_SLASHER_BACKEND
+            # (device-resident span store / numpy twin); the seed per-row
+            # Slasher remains importable as the DB-backed reference twin
+            slasher = make_slasher(store.hot, chain.ns)
             slasher_service = SlasherService(chain, slasher, op_pool)
             # subscribe to the chain's ingest seams (service.rs gossip taps)
             chain.block_observers.append(slasher_service.block_observed)

@@ -25,6 +25,8 @@ Canonical fault domains:
   (``beacon_chain.chain._batch_verify_items`` and through it the firehose).
 * ``epoch_supervisor()`` — the device epoch engine
   (``epoch_engine.engine.process_epoch_on_device``).
+* ``slasher_supervisor()`` — the device-resident slasher span store
+  (``slasher.engine.SpanStore``; injection stage ``slasher.sweep``).
 """
 
 from __future__ import annotations
@@ -60,6 +62,7 @@ from .supervisor import (  # noqa: F401
 
 BLS_DOMAIN = "bls_device"
 EPOCH_DOMAIN = "epoch_device"
+SLASHER_DOMAIN = "slasher_device"
 
 
 def bls_supervisor() -> BackendSupervisor:
@@ -70,6 +73,14 @@ def bls_supervisor() -> BackendSupervisor:
 def epoch_supervisor() -> BackendSupervisor:
     """The fault domain guarding the device epoch engine."""
     return get_supervisor(EPOCH_DOMAIN)
+
+
+def slasher_supervisor() -> BackendSupervisor:
+    """The fault domain guarding the device-resident slasher span store
+    (``slasher/engine.py``): a faulted ``slasher.sweep`` restores the host
+    checkpoint + replays the pair journal on the numpy twin, so demotion
+    never drops evidence."""
+    return get_supervisor(SLASHER_DOMAIN)
 
 
 def health_snapshot() -> dict:

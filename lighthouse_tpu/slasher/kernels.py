@@ -1,0 +1,174 @@
+"""Fused device kernels for the whole-registry slasher engine.
+
+The seed path (``arrays.py``) updates ``[validator_chunk_size,
+history_length]`` *rows* on demand through a host-side DB: surveillance cost
+grows with the number of touched rows and the planes round-trip through the
+store every batch. This module is the same math taken to registry scale —
+ONE ``[n_validators, history_length]`` pair of min/max distance planes (plus
+a vote-hash plane for double-vote candidates) that stays device-resident
+across ticks, with per-batch update + detection as a single jitted
+scatter / cumulative-scan sweep:
+
+  1. **window advance** — the current epoch moved by ``delta`` since the
+     last sweep: distances are invariant under window shifts (the seed's
+     per-row encoding, array.rs:14,84-99), so the advance is a roll along
+     the epoch axis + neutral fill of the new columns. ``delta`` is a
+     TRACED argument: epoch rolls never recompile.
+  2. **scatter** — attestation ``(v, s, t)`` applies ``min`` over columns
+     ``[window_start, s-1]`` and ``max`` over ``[s+1, current_epoch]``
+     (array.rs:219-244,322-347); both intervals always extend to a window
+     edge, so a batch collapses to a scatter-min of ``t`` at column ``s-1``
+     (resp. scatter-max at ``s+1``) over the whole plane.
+  3. **directional scans** — one reverse cumulative min (resp. forward
+     cumulative max) along the epoch axis completes every interval.
+  4. **per-pair reads** — each pair reads the post-update planes at its own
+     source column (its own writes never touch that column), yielding
+     surround / surrounded candidate flags; the vote-hash plane yields
+     double-vote candidates (a different 32-bit data-root tag already
+     recorded at the pair's target column, or two different tags landing on
+     the same cell within the batch).
+
+The kernel only FLAGS. Every flagged pair is re-confirmed host-side against
+the fetched attestation record before a slashing is emitted — the
+One-For-All attribution bar: an aggregate proves the *set* signed, only the
+record proves *which* prior vote conflicts (engine.py). A 32-bit vote tag
+can collide (two distinct data roots sharing a prefix suppress a candidate
+with probability 2^-32 per conflicting pair); the host confirmation
+compares full roots, so collisions can only suppress a candidate flag,
+never produce a false slashing.
+
+``lighthouse_tpu/slasher/engine.py`` holds the field-for-field numpy twin
+(``sweep_numpy``) — this module is only imported on the device path, so the
+``numpy`` backend never pays a jax import.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import MAX_DISTANCE
+
+_INT_INF = np.int32(2**31 - 1)
+_VOTE_NONE = np.uint32(0xFFFFFFFF)  # scatter-min identity for the vote plane
+
+# Static headroom bound for the int32 target-domain arithmetic: every epoch
+# the kernel sees must leave ``MAX_DISTANCE + history`` of int32 headroom.
+# 2^24 epochs is ~6,800 years of chain time; the host wrappers enforce it.
+MAX_EPOCH = 1 << 24
+
+
+def sweep_impl(min_d, max_d, vote_h, delta, vidx, src, tgt, vh, valid, cur, *, n):
+    """Advance + batch-update + candidate detection over the whole registry.
+
+    min_d, max_d : uint16[V, N]  distance planes (linear window layout,
+                                 newest epoch in the last column)
+    vote_h       : uint32[V, N]  data-root tag recorded per target column
+    delta        : int32         window advance (cur - stored_epoch), traced
+    vidx,src,tgt : int32[P]      flattened (attestation x validator) pairs
+    vh           : uint32[P]     nonzero data-root tag per pair
+    valid        : bool[P]       padding mask
+    cur          : int32         current epoch (last column's epoch)
+
+    Returns ``(new_min_d, new_max_d, new_vote_h, min_target, max_target,
+    min_flag, max_flag, dbl_flag)`` — targets are the per-pair post-update
+    plane reads the host uses to fetch the existing record on a flagged
+    candidate.
+    """
+    from ..ops.bls.fq import _cert
+
+    # trace-time proof obligations (recorded by the bounds certifier when
+    # its sink is installed; plain asserts otherwise)
+    assert _cert(
+        "slasher_distance_width", MAX_DISTANCE, 0xFFFF,
+        "distance sentinel fits the u16 plane dtype",
+    )
+    assert _cert(
+        "slasher_target_domain", MAX_EPOCH + MAX_DISTANCE + n, _INT_INF,
+        "int32 target-domain arithmetic cannot wrap below MAX_EPOCH",
+    )
+    assert _cert(
+        "slasher_window_width", n - 1, MAX_DISTANCE,
+        "max in-window distance (n-1) representable in the u16 encoding",
+    )
+
+    base = cur - (n - 1)
+    j = jnp.arange(n, dtype=jnp.int32)
+    e = base + j  # epoch of each column
+
+    # -- 1. window advance: roll left by delta, neutral-fill new columns.
+    dl = jnp.clip(delta, 0, n)
+    fresh = j >= n - dl
+    min_d = jnp.where(fresh, jnp.uint16(MAX_DISTANCE), jnp.roll(min_d, -dl, axis=1))
+    max_d = jnp.where(fresh, jnp.uint16(0), jnp.roll(max_d, -dl, axis=1))
+    vote_h = jnp.where(fresh, jnp.uint32(0), jnp.roll(vote_h, -dl, axis=1))
+
+    old_min_t = e[None, :] + min_d.astype(jnp.int32)
+    old_max_t = e[None, :] + max_d.astype(jnp.int32)
+    v_cap = min_d.shape[0]
+    vi = jnp.clip(vidx, 0, v_cap - 1)
+
+    # -- 2. scatter + directional scans in the int32 target domain.
+    # Invalid / out-of-window columns are routed to index n, which scatter
+    # mode="drop" discards.
+    def route(col, ok):
+        return jnp.where(ok & (col >= 0) & (col < n), col, n)
+
+    col_min = route(src - 1 - base, valid)
+    col_max = route(src + 1 - base, valid)
+    col_t = route(tgt - base, valid)
+
+    scat_min = jnp.full((v_cap, n), _INT_INF, jnp.int32).at[vi, col_min].min(
+        tgt, mode="drop"
+    )
+    scat_max = jnp.full((v_cap, n), -_INT_INF, jnp.int32).at[vi, col_max].max(
+        tgt, mode="drop"
+    )
+    # min_targets[e] aggregates attestations with source-1 >= e: suffix scan;
+    # max_targets[e] aggregates attestations with source+1 <= e: prefix scan.
+    suff_min = jax.lax.cummin(scat_min, axis=1, reverse=True)
+    pref_max = jax.lax.cummax(scat_max, axis=1)
+
+    new_min_t = jnp.minimum(old_min_t, suff_min)
+    new_max_t = jnp.maximum(old_max_t, pref_max)
+    new_min_d = jnp.clip(new_min_t - e[None, :], 0, MAX_DISTANCE).astype(jnp.uint16)
+    new_max_d = jnp.clip(new_max_t - e[None, :], 0, MAX_DISTANCE).astype(jnp.uint16)
+
+    # -- 3. vote-hash plane: first-seen tag wins (the record path keeps the
+    # existing attestation, ref database.rs:585-640); candidates are a
+    # pre-existing different tag or an intra-batch tag conflict.
+    col_t_c = jnp.clip(col_t, 0, n - 1)
+    in_w = col_t < n
+    pre = jnp.where(in_w, vote_h[vi, col_t_c], jnp.uint32(0))
+    smin = jnp.full((v_cap, n), _VOTE_NONE, jnp.uint32).at[vi, col_t].min(
+        vh, mode="drop"
+    )
+    smax = jnp.zeros((v_cap, n), jnp.uint32).at[vi, col_t].max(vh, mode="drop")
+    new_vote_h = jnp.where(
+        vote_h != 0, vote_h, jnp.where(smin != _VOTE_NONE, smin, jnp.uint32(0))
+    )
+    smin_p = smin[vi, col_t_c]
+    smax_p = smax[vi, col_t_c]
+    dbl_flag = valid & in_w & (
+        ((pre != 0) & (pre != vh)) | (smin_p != smax_p)
+    )
+
+    # -- 4. post-update surround reads at each pair's own source column.
+    col_s = jnp.clip(src - base, 0, n - 1)
+    min_target = new_min_d[vi, col_s].astype(jnp.int32) + e[col_s]
+    max_target = new_max_d[vi, col_s].astype(jnp.int32) + e[col_s]
+    min_flag = valid & (tgt > min_target)
+    max_flag = valid & (tgt < max_target)
+    return (
+        new_min_d, new_max_d, new_vote_h,
+        min_target, max_target, min_flag, max_flag, dbl_flag,
+    )
+
+
+# the serving entrypoint; the bounds certifier traces ``sweep_impl``
+# directly so each backend/batch regime re-records its obligations instead
+# of hitting the jit cache
+sweep = functools.partial(jax.jit, static_argnames=("n",))(sweep_impl)

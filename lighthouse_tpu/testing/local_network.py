@@ -36,10 +36,11 @@ from .harness import StateHarness
 
 class LocalNetwork:
     def __init__(self, spec: ChainSpec, n_nodes: int, n_validators: int,
-                 transport: str = "loopback"):
+                 transport: str = "loopback", slasher: bool = False):
         assert n_validators % n_nodes == 0
         self.spec = spec
         self.mode = transport
+        self.slasher_enabled = slasher
         self.dead: set[int] = set()   # crashed node indices (chaos harness)
         self.missed_proposals = 0     # invalid-on-own-chain proposals skipped
         self._chaos_seen = False      # any crash/loss ever armed this run
@@ -105,7 +106,28 @@ class LocalNetwork:
                     svc.connect(peer)
         else:
             raise ValueError(f"unknown transport mode {transport!r}")
+        if slasher:
+            for svc in self.nodes:
+                self._attach_slasher(svc)
         self._msg_total = 0  # messages published so far (settle accounting)
+
+    def _attach_slasher(self, svc) -> None:
+        """Per-node slasher service on the chain's ingest seams: every
+        gossip-verified attestation and every imported block (gossip AND
+        range sync) flows into the engine; ``run_slot`` ticks it so found
+        slashings drain into the node's op pool and ride the next proposal
+        (the full gossip -> slasher -> op_pool -> block-inclusion loop)."""
+        from ..slasher import SlasherConfig, SlasherService, make_slasher
+
+        sl = make_slasher(
+            None, svc.chain.ns,
+            SlasherConfig(validator_chunk_size=16, history_length=64),
+        )
+        svc.slasher_service = SlasherService(svc.chain, sl, svc.op_pool)
+        svc.chain.block_observers.append(svc.slasher_service.block_observed)
+        svc.chain.attestation_observers.append(
+            svc.slasher_service.attestation_observed
+        )
 
     def settle(self, timeout: float = 5.0) -> None:
         """Wait until every node has RECEIVED and PROCESSED every message
@@ -206,6 +228,8 @@ class LocalNetwork:
         )
         self.nodes[i] = svc
         self.dead.discard(i)
+        if self.slasher_enabled:
+            self._attach_slasher(svc)
         for peer in self.transport.peers(exclude=svc.node_id):
             try:
                 svc.connect(peer)
@@ -234,8 +258,11 @@ class LocalNetwork:
         ).tree_root()
         reveal = self.harness._sign(proposer, randao_root)
         atts = node.op_pool.get_attestations(state)
+        # op_pool rides along so pooled slashing evidence (the slasher
+        # service drains into it each slot) is included in the block
         block, _post = chain.produce_block_on_state(
-            chain.head.state, slot, reveal, attestations=atts
+            chain.head.state, slot, reveal, attestations=atts,
+            op_pool=node.op_pool,
         )
         fork = spec.fork_name_at_epoch(epoch)
         block_cls = node.chain.ns.block_types[fork]
@@ -304,6 +331,12 @@ class LocalNetwork:
         self.settle()
         self._attest(slot)
         self.settle()
+        if self.slasher_enabled:
+            epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+            for i, node in enumerate(self.nodes):
+                svc = getattr(node, "slasher_service", None)
+                if i not in self.dead and svc is not None:
+                    svc.tick(current_epoch=epoch)
 
     def run_until(self, last_slot: int, start: int = 1) -> None:
         for slot in range(start, last_slot + 1):

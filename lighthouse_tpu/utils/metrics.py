@@ -285,6 +285,19 @@ SLASHER_CHUNKS_UPDATED = REGISTRY.counter(
     "Slasher target-array rows updated (slasher/src/metrics.rs)",
     label_names=("array",),
 )
+SLASHER_PAIRS_SWEPT = REGISTRY.counter(
+    "slasher_pairs_swept_total",
+    "(attestation x validator) pairs through the span-store sweep, by the "
+    "rung that served them (device / host)",
+    label_names=("backend",),
+)
+SLASHER_SURVEILLANCE_GAP = REGISTRY.counter(
+    "slasher_surveillance_gap_total",
+    "Evidence pairs the slasher engine SHED (intake overflow, exhausted "
+    "batch retries) — any nonzero rate is a surveillance gap, never a "
+    "silent drop",
+    label_names=("reason",),
+)
 STORE_FREEZE_TIMES = REGISTRY.histogram(
     "store_beacon_state_freeze_seconds",
     "Cold-migration time per state (store/src/metrics.rs)",

@@ -74,6 +74,34 @@ class TestChain:
         assert len(roots) == 4
         assert chain.head.slot == 4
 
+    def test_chain_segment_fires_block_observers(self, chain_and_harness):
+        """Range-synced blocks carry slashing evidence too: the slasher's
+        ``block_observers`` subscription must fire for EVERY import path,
+        not just gossip (chain.py's process_chain_segment tail)."""
+        from lighthouse_tpu.slasher import SlasherConfig, SlasherService, make_slasher
+
+        chain, h, clock = chain_and_harness
+        seen = []
+        chain.block_observers.append(seen.append)
+        slasher = make_slasher(
+            None, chain.ns, SlasherConfig(history_length=64), backend="numpy"
+        )
+        svc = SlasherService(chain, slasher)
+        chain.block_observers.append(svc.block_observed)
+        blocks = []
+        for slot in (1, 2, 3):
+            b = h.produce_block(slot)
+            h.apply_block(b)
+            blocks.append(b)
+        clock.set_slot(3)
+        chain.process_chain_segment(blocks)
+        # every range-synced block reached the observers, in import order
+        assert seen == blocks
+        # and the evidence actually flowed into the slasher's block queue
+        stats = slasher.process_queued(0)
+        assert stats["blocks_processed"] == 3
+        assert stats["proposer_slashings"] == 0  # honest chain: no evidence
+
     def test_attestation_batch_with_poison(self, chain_and_harness):
         chain, h, clock = chain_and_harness
         clock.set_slot(1)

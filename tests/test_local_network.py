@@ -50,3 +50,93 @@ def test_partitioned_node_catches_up_via_range_sync(net):
     net.nodes[3].connect("node_0")
     assert net.nodes[3].chain.head.slot == ahead
     assert net.nodes[3].chain.head.root == net.nodes[0].chain.head.root
+
+
+def test_slasher_gossip_to_block_inclusion():
+    """The full surveillance loop (ISSUE 11): a validator equivocates over
+    gossip -> every peer's slasher engine flags + confirms the double vote
+    -> the AttesterSlashing drains into the op pool -> a later proposal
+    includes it -> the equivocator ends up slashed on EVERY node. Honest
+    traffic all the while produces zero false positives."""
+    import numpy as np
+
+    from lighthouse_tpu.state_transition import (
+        get_beacon_committee,
+        get_committee_count_per_slot,
+        get_current_epoch,
+        process_slots,
+    )
+    from lighthouse_tpu.testing.local_network import _block_root_at
+    from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+    from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+    spec = minimal_spec()
+    net = LocalNetwork(spec, n_nodes=2, n_validators=16, slasher=True)
+    net.run_until(4)
+    assert net.heads_agree()
+
+    # craft the equivocation: a node-0 validator re-signs its duty slot's
+    # attestation with a different (known) beacon block root
+    node = net.nodes[0]
+    slot = 5
+    net.clock.set_slot(slot)
+    state = node.chain.head.state.copy()
+    if state.slot < slot:
+        process_slots(spec, state, slot)
+    epoch = get_current_epoch(spec, state)
+    domain = get_domain(spec, state, spec.DOMAIN_BEACON_ATTESTER, epoch=epoch)
+    target_root = (
+        node.chain.head.root
+        if slot == spec.start_slot(epoch)
+        else _block_root_at(spec, state, spec.start_slot(epoch))
+    )
+    found = None
+    for index in range(get_committee_count_per_slot(spec, state, epoch)):
+        committee = get_beacon_committee(spec, state, slot, index)
+        for pos, v in enumerate(committee):
+            if int(v) in net.owned[0]:
+                found = (index, committee, pos, int(v))
+                break
+        if found:
+            break
+    index, committee, pos, v = found
+
+    def crafted(root):
+        data = AttestationData(
+            slot=slot, index=index, beacon_block_root=root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+        bits = np.zeros(committee.size, dtype=bool)
+        bits[pos] = True
+        return node.chain.ns.Attestation(
+            aggregation_bits=bits, data=data,
+            signature=net.harness._sign(v, compute_signing_root(data, domain)),
+        )
+
+    for att in (crafted(node.chain.head.root),
+                crafted(node.chain.genesis_block_root)):
+        node.publish_attestation(att)
+        net._msg_total += 1
+    net.settle()
+    # the PEER's slasher saw both votes over gossip: tick -> pool
+    stats = net.nodes[1].slasher_service.tick(current_epoch=epoch)
+    assert stats["double_vote_slashings"] >= 1, stats
+    assert len(net.nodes[1].op_pool._attester_slashings) >= 1
+
+    # keep the network running: the slashing rides the next node-1 proposal
+    for s in range(slot, slot + 8):
+        net.run_slot(s)
+        if all(
+            bool(n.chain.head.state.validators[v].slashed) for n in net.nodes
+        ):
+            break
+    else:
+        raise AssertionError("equivocator never slashed on all nodes")
+    # zero false positives: nobody else got slashed
+    for n in net.nodes:
+        slashed = [
+            i for i, val in enumerate(n.chain.head.state.validators)
+            if val.slashed
+        ]
+        assert slashed == [v], slashed

@@ -59,6 +59,8 @@ RECORD_EPOCH = os.path.join(CACHE, "tpu_epoch_record.json")
 RECORD_EPOCH_SHARDED = os.path.join(CACHE, "tpu_epoch_sharded_record.json")
 RECORD_H2C = os.path.join(CACHE, "tpu_h2c_record.json")
 RECORD_PAIRING = os.path.join(CACHE, "tpu_pairing_record.json")
+RECORD_SLASHER = os.path.join(CACHE, "tpu_slasher_record.json")
+RECORD_SLASHER_SHARDED = os.path.join(CACHE, "tpu_slasher_sharded_record.json")
 RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
@@ -98,8 +100,17 @@ RUNGS.insert(4, bench._EPOCH_SHARDED_RUNG_SMALL)
 # pairing sets/s, each with per-stage chain timings and in-rung oracle parity
 RUNGS.insert(1, bench._PAIRING_RUNG_SMALL)
 RUNGS.insert(1, bench._H2C_RUNG_SMALL)
+# slasher-engine rung (ISSUE 11): the 32k whole-registry surveillance sweep
+# rides mid-ladder (its scatter/scan program is tiny next to the BLS
+# kernels, so it stays compile-warm in .jax_cache); the 1M plane is a
+# stretch rung. Like every rung it starts only behind the bench-main flock
+# marker check in main(), and its record carries the _resilience_summary
+# integrity stamp + span-store mode, so a numpy-demoted run can't
+# masquerade as a device record.
+RUNGS.insert(5, bench._SLASHER_RUNG_SMALL)
 RUNGS.append(bench._EPOCH_RUNG_FULL)
 RUNGS.append(bench._EPOCH_SHARDED_RUNG_FULL)
+RUNGS.append(bench._SLASHER_RUNG_FULL)
 
 
 def log(event: str, **kw) -> None:
@@ -251,6 +262,8 @@ def persist(rec: dict, rung_idx: int) -> None:
         ("epoch_validators_per_s", True): RECORD_EPOCH_SHARDED,
         ("h2c_points_per_s", False): RECORD_H2C,
         ("pairing_sets_per_s", False): RECORD_PAIRING,
+        ("slashable_checks_per_s", False): RECORD_SLASHER,
+        ("slashable_checks_per_s", True): RECORD_SLASHER_SHARDED,
     }.get((rec.get("metric"), sharded), RECORD)
     best = None
     try:
