@@ -541,6 +541,14 @@ def _resilience_summary() -> dict | None:
     snaps = snapshot_all()
     demotions = sum(s["demotions"] for s in snaps.values())
     fallback = sum(s["fallback_calls"] for s in snaps.values())
+    try:
+        from lighthouse_tpu.beacon_chain.recovery import (
+            snapshot_recovery_totals,
+        )
+
+        recovery = snapshot_recovery_totals()
+    except Exception:  # noqa: BLE001 — the stamp must never fail a record
+        recovery = None
     return {
         "demotions": demotions,
         "fallback_calls": fallback,
@@ -549,6 +557,10 @@ def _resilience_summary() -> dict | None:
         ),
         "degraded": bool(demotions or fallback),
         "supervisor_states": {k: v["state"] for k, v in snaps.items()},
+        # crash-recovery integrity (ISSUE 12): a measurement that silently
+        # restarted from disk mid-run (or replayed/truncated WAL records)
+        # is visible in the record
+        "recovery": recovery,
     }
 
 

@@ -124,24 +124,33 @@ def main(argv=None) -> int:
             )
 
     if run_lint:
+        from .durability import lint_tree as durability_lint_tree
         from .hygiene import lint_tree
 
         findings, suppressed = lint_tree()
+        # durability rider (ISSUE 12): multi-key persistence sequences
+        # bypassing do_atomically on the block-import/finalization paths
+        dur_findings, dur_suppressed = durability_lint_tree()
         report["lint"] = {
-            "ok": not findings,
+            "ok": not findings and not dur_findings,
             "n_findings": len(findings),
             "n_baseline_suppressed": suppressed,
             "findings": [f.as_dict() for f in findings],
+            "n_durability_findings": len(dur_findings),
+            "n_durability_baseline_suppressed": dur_suppressed,
+            "durability_findings": [f.as_dict() for f in dur_findings],
         }
-        if findings:
+        if findings or dur_findings:
             report["ok"] = False
             rc = 1
         if not args.json:
-            for f in findings:
+            for f in findings + dur_findings:
                 print(str(f), file=sys.stderr)
             print(
                 f"lint: {len(findings)} finding(s), {suppressed} baseline-"
-                f"suppressed — {'FAIL' if findings else 'ok'}",
+                f"suppressed; durability: {len(dur_findings)} finding(s), "
+                f"{dur_suppressed} baseline-suppressed — "
+                f"{'FAIL' if findings or dur_findings else 'ok'}",
                 file=sys.stderr,
             )
 

@@ -80,6 +80,12 @@ class BeaconChain:
         self.spec = spec
         self.ns = for_preset(spec.preset.name)
         self.store = store or HotColdDB()
+        # fork choice persists on EVERY import only when the hot store is
+        # durable (a WAL store with replay): persisting per-import into a
+        # MemoryStore buys nothing — it dies with the process — and the
+        # serialize cost is real on import-heavy paths. Shutdown persist
+        # (Client.stop) stays unconditional.
+        self._durable = hasattr(self.store.hot, "recovery_stats")
         self.slot_clock = slot_clock or ManualSlotClock(0)
         self.execution_layer = execution_layer
         self.eth1_service = None  # optional deposit/eth1-data bridge (eth1/)
@@ -609,10 +615,19 @@ class BeaconChain:
                 )
             if not self._batch_verify_items(items):
                 raise BlockError("backfill segment signatures invalid")
+            # the segment was validated as a unit; persist it as ONE atomic
+            # frame so a crash mid-backfill never leaves a gappy history
+            from ..store.kv import DBColumn
+
+            self.store.do_atomically(
+                [
+                    ("put", DBColumn.BeaconBlock, root, type(sb).encode(sb))
+                    for sb, root in zip(blocks, roots)
+                ]
+            )
             for sb, root in zip(blocks, roots):
                 self._blocks[root] = sb
                 self._seen_blocks.add(root)
-                self.store.put_block(root, type(sb).encode(sb))
             self._oldest_block_slot = int(blocks[0].message.slot)
             self._oldest_block_parent = bytes(blocks[0].message.parent_root)
             return len(blocks)
@@ -636,6 +651,11 @@ class BeaconChain:
             if sc is None or bytes(sc.kzg_commitment) != bytes(comms[i]):
                 raise BlobError(f"segment blob {i} missing or mismatched")
 
+    # the looped write below is each block's blob sidecars AFTER that
+    # block's atomic import: one single-key put per block, independent per
+    # block (a crash between two blocks' sidecar writes tears nothing; a
+    # missing sidecar set re-arrives via sync)
+    # lint: allow(torn-write)
     def _process_chain_segment_locked(self, blocks, roots, blobs_by_root) -> list:
         from ..state_transition.per_block import BlockSignatureVerifier
 
@@ -711,9 +731,16 @@ class BeaconChain:
     ) -> None:
         block = signed_block.message
         self.pubkey_cache.import_new_pubkeys(state)
-        self.store.put_block(block_root, type(signed_block).encode(signed_block))
-        state_ssz = type(state).encode(state)
-        self.store.put_state(state.tree_root(), state_ssz, state.slot)
+        # the block-import persistence barrier: block + post-state + slot
+        # summary as ONE atomic frame (a kill mid-import can never leave a
+        # block whose post-state is missing, or vice versa)
+        self.store.atomic_block_import(
+            block_root,
+            type(signed_block).encode(signed_block),
+            state.tree_root(),
+            type(state).encode(state),
+            int(state.slot),
+        )
         self._states[block_root] = state
         self._blocks[block_root] = signed_block
         self._seen_blocks.add(block_root)
@@ -738,6 +765,20 @@ class BeaconChain:
             except Exception:
                 pass
         self.recompute_head()
+        # fork-choice persistence barrier (persisted_fork_choice.rs runs on
+        # every import too): a crash after this point restarts at THIS head;
+        # a crash between the block batch and here restarts one block back
+        # and re-imports it from gossip/sync — never from genesis
+        if self._durable:
+            self.persist_fork_choice()
+
+    def persist_fork_choice(self) -> None:
+        """Snapshot fork choice into the store's metadata bucket (the
+        restart-from-disk anchor). Runs under the chain lock on the import
+        path; also the shutdown path's persistence hook."""
+        from ..fork_choice import persistence as fc_persist
+
+        fc_persist.persist(self.store, self.fork_choice)
 
     # -- attestations ---------------------------------------------------------------
 

@@ -12,8 +12,6 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from ..beacon_chain.chain import BeaconChain
-from ..op_pool import OperationPool
 from ..store.hot_cold import HotColdDB, StoreConfig
 from ..store.kv import LevelStore
 from ..types.spec import ChainSpec
@@ -148,19 +146,18 @@ class Client:
             self.metrics_server.stop()
         if self.network_service is not None:
             self.network_service.stop()
-        # persist fork choice + op pool for the next boot
-        # (persisted_fork_choice.rs / operation_pool persistence.rs)
+        # persist fork choice + op pool + slasher for the next boot
+        # (persisted_fork_choice.rs / operation_pool persistence.rs) —
+        # through the same crash-point barriers the import path uses
         try:
-            from ..fork_choice import persistence as fc_persist
             from ..op_pool import persistence as pool_persist
 
-            self.chain.store.put_meta(
-                fc_persist.META_KEY,
-                fc_persist.serialize_fork_choice(self.chain.fork_choice),
-            )
-            self.chain.store.put_meta(
-                pool_persist.META_KEY, pool_persist.serialize_pool(self.op_pool)
-            )
+            self.chain.persist_fork_choice()
+            pool_persist.persist(self.chain.store, self.op_pool)
+            if self.slasher_service is not None:
+                persist = getattr(self.slasher_service.slasher, "persist", None)
+                if persist is not None:
+                    persist()
         except Exception as e:  # noqa: BLE001 — shutdown must not fail
             log.warn("Persistence on shutdown failed", error=str(e))
 
@@ -228,24 +225,46 @@ class ClientBuilder:
         self._slot_clock = clock
         return self
 
+    GENESIS_TIME_KEY = b"genesis_time_v1"
+
     def build(self) -> Client:
         cfg = self.config
         init_logging(cfg.debug_level)
-        if self._genesis_state is None:
-            self.interop_genesis()
-        state = self._genesis_state
 
         if cfg.datadir:
             import os
 
             os.makedirs(cfg.datadir, exist_ok=True)
+            # the production node fsyncs every WAL commit (power-loss
+            # durability); the test/simulation tier leaves fsync off
             store = HotColdDB(
-                hot=LevelStore(os.path.join(cfg.datadir, "chain.db")),
-                cold=LevelStore(os.path.join(cfg.datadir, "freezer.db")),
+                hot=LevelStore(
+                    os.path.join(cfg.datadir, "chain.db"), fsync=True
+                ),
+                cold=LevelStore(
+                    os.path.join(cfg.datadir, "freezer.db"), fsync=True
+                ),
                 config=StoreConfig(),
             )
         else:
             store = HotColdDB()
+
+        if self._genesis_state is None:
+            # an interop genesis must be the SAME one across restarts —
+            # time.time() at each boot makes the datadir's whole chain
+            # foreign to the new anchor and recovery silently degrades to
+            # genesis. The first boot records its genesis time; later
+            # boots re-derive the identical deterministic genesis from it.
+            stored = store.get_meta(self.GENESIS_TIME_KEY)
+            if stored is not None and self.config.genesis_time is None:
+                self.config.genesis_time = int(stored.decode())
+            self.interop_genesis()
+            if cfg.datadir and stored is None:
+                store.put_meta(
+                    self.GENESIS_TIME_KEY,
+                    str(int(self._genesis_state.genesis_time)).encode(),
+                )
+        state = self._genesis_state
 
         clock = self._slot_clock
         if clock is None:
@@ -256,51 +275,26 @@ class ClientBuilder:
                 if cfg.use_system_clock
                 else ManualSlotClock(0)
             )
-        chain = BeaconChain(self.spec, state, store=store, slot_clock=clock)
+        # the restart-from-disk path (beacon_chain/recovery.py): WAL replay
+        # already ran inside the LevelStore opens; recovery adopts the
+        # persisted fork choice (head + weights + finality) and rehydrates
+        # the op pool — a fresh in-memory boot degrades to the same call
+        # with empty stores
+        from ..beacon_chain.recovery import recover_node_state
+
+        chain, op_pool, recovered = recover_node_state(
+            self.spec, state, store, slot_clock=clock
+        )
         if self._eth1 is not None:
             chain.eth1_service = self._eth1
-        op_pool = OperationPool(self.spec, chain.ns.Attestation)
-
-        # restore persisted fork choice + op pool (persisted_fork_choice.rs,
-        # operation_pool/persistence.rs): best-effort — a corrupt or
-        # incompatible snapshot falls back to the fresh anchor
-        from ..fork_choice import persistence as fc_persist
-        from ..op_pool import persistence as pool_persist
-
-        blob = store.get_meta(fc_persist.META_KEY)
-        if blob:
-            fresh_fc = chain.fork_choice
-            try:
-                restored = fc_persist.restore_fork_choice(self.spec, blob)
-                if chain.genesis_block_root in restored.proto.indices:
-                    # rehydrate the unfinalized blocks the restored graph
-                    # references — imports, production and serving all key
-                    # off the chain's block/seen maps
-                    for node in restored.proto.nodes:
-                        raw = store.get_block(node.root)
-                        if raw is not None:
-                            fork = self.spec.fork_name_at_slot(node.slot)
-                            chain._blocks[node.root] = chain.ns.block_types[
-                                fork
-                            ].decode(raw)
-                        chain._seen_blocks.add(node.root)
-                    chain.fork_choice = restored
-                    chain.recompute_head()
-                    log.info(
-                        "Fork choice restored",
-                        nodes=len(restored.proto.nodes),
-                        head=chain.head.root.hex()[:10],
-                    )
-            except Exception as e:  # noqa: BLE001 — stale snapshot
-                chain.fork_choice = fresh_fc
-                log.warn("Fork choice restore failed", error=str(e))
-        blob = store.get_meta(pool_persist.META_KEY)
-        if blob:
-            try:
-                n = pool_persist.restore_pool(op_pool, chain.ns, blob)
-                log.info("Op pool restored", attestations=n)
-            except Exception as e:  # noqa: BLE001
-                log.warn("Op pool restore failed", error=str(e))
+        if recovered["fork_choice_restored"]:
+            log.info(
+                "Fork choice restored",
+                nodes=recovered["fc_nodes"],
+                head=chain.head.root.hex()[:10],
+            )
+        if recovered["pool_restored"]:
+            log.info("Op pool restored", attestations=recovered["pool_restored"])
 
         network_service = None
         if cfg.listen_port is not None:
@@ -380,8 +374,13 @@ class ClientBuilder:
 
             # the engine-backed slasher behind LIGHTHOUSE_SLASHER_BACKEND
             # (device-resident span store / numpy twin); the seed per-row
-            # Slasher remains importable as the DB-backed reference twin
-            slasher = make_slasher(store.hot, chain.ns)
+            # Slasher remains importable as the DB-backed reference twin.
+            # The checkpoint store only rides a durable (WAL) datadir —
+            # compressing the full span planes into a MemoryStore every
+            # tick is wasted work that dies with the process (same gate as
+            # the chain's per-import fork-choice persist)
+            ckpt_store = store.hot if cfg.datadir else None
+            slasher = make_slasher(ckpt_store, chain.ns)
             slasher_service = SlasherService(chain, slasher, op_pool)
             # subscribe to the chain's ingest seams (service.rs gossip taps)
             chain.block_observers.append(slasher_service.block_observed)

@@ -19,6 +19,19 @@ from .proto_array import ExecutionStatus, ProtoArrayForkChoice, ProtoNode
 
 META_KEY = b"fork_choice_v1"
 
+
+def persist(store, fc: "ForkChoice") -> None:
+    """The fork-choice persistence barrier: serialize + one metadata put
+    (a single-key write — atomic at the WAL frame layer). The
+    ``persist.fork_choice`` crash point lets the chaos sweep kill a node
+    exactly between the block batch and this snapshot."""
+    from ..resilience.crashpoints import maybe_crash
+
+    maybe_crash(
+        "persist.fork_choice", owner=getattr(store.hot, "owner", None)
+    )
+    store.put_meta(META_KEY, serialize_fork_choice(fc))
+
 _hex = bytes.hex
 
 

@@ -67,6 +67,11 @@ class LoopbackTransport(Transport):
         self._loss_rng = None
         self.gossip_delivered = 0
         self.gossip_dropped = 0  # seeded-loss drops only (not partitions)
+        # chaos-harness hook: called with an InjectedCrash raised by a
+        # RECIPIENT during delivery. A kill -9 of one subscriber must not
+        # unwind the publisher's fan-out — the hook crashes that node and
+        # delivery continues to the remaining peers.
+        self.on_injected_crash = None
 
     def register(self, peer_id: str, service) -> None:
         if peer_id in self._handlers:
@@ -105,7 +110,17 @@ class LoopbackTransport(Transport):
                 self.gossip_dropped += 1
                 continue
             self.gossip_delivered += 1
-            svc.on_gossip(topic, message, from_peer)
+            if self.on_injected_crash is None:
+                svc.on_gossip(topic, message, from_peer)
+                continue
+            from ..resilience import InjectedCrash
+
+            try:
+                svc.on_gossip(topic, message, from_peer)
+            except InjectedCrash as e:
+                # the recipient died at one of its persistence barriers;
+                # the publisher and every other peer keep going
+                self.on_injected_crash(e)
 
     def request(self, from_peer: str, to_peer: str, method: str, payload):
         if self._blocked(from_peer, to_peer):

@@ -19,6 +19,16 @@ from ..types.containers import AttestationData
 META_KEY = b"op_pool_v1"
 
 
+def persist(store, pool) -> None:
+    """The op-pool persistence barrier: serialize + one metadata put (the
+    ``persist.op_pool`` crash point; shutdown AND per-slot durable-datadir
+    cadence both route through here)."""
+    from ..resilience.crashpoints import maybe_crash
+
+    maybe_crash("persist.op_pool", owner=getattr(store.hot, "owner", None))
+    store.put_meta(META_KEY, serialize_pool(pool))
+
+
 def serialize_pool(pool) -> bytes:
     with pool._lock:
         atts = []

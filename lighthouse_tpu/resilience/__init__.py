@@ -42,6 +42,10 @@ from .faults import (  # noqa: F401
     recent_faults,
     record_fault,
 )
+from .crashpoints import (  # noqa: F401
+    InjectedCrash,
+    maybe_crash,
+)
 from .inject import (  # noqa: F401
     ENV_VAR as INJECT_ENV_VAR,
     FaultInjector,

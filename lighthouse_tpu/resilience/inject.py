@@ -25,7 +25,11 @@ Fields:
 * ``mode``   — ``raise`` (default), ``hang`` (sleep past the watchdog
   deadline), ``corrupt`` (raise a limb-bound-assert-shaped error, the
   *detected*-corruption fault: the certifier's bound asserts are exactly
-  what turns silent bad numerics into a classified fault).
+  what turns silent bad numerics into a classified fault), or the crash
+  modes ``kill`` / ``tear`` (simulate the process dying at a persistence
+  barrier — consumed ONLY through ``crash_action`` by the crash-point
+  hooks in ``crashpoints.py``, never by ``before_call``, so a supervised
+  device stage can never accidentally absorb a "process death").
 * ``kind``   — for ``raise``: ``transient`` (default) or ``oom``.
 * ``every=K`` / ``at=N`` — fire on every Kth call / only on the Nth call.
 * ``times=T`` — stop after T firings (default unlimited).
@@ -120,7 +124,7 @@ def _parse_clause(clause: str) -> _Plan:
         if k == "stage":
             kw["stage"] = v
         elif k == "mode":
-            if v not in ("raise", "hang", "corrupt"):
+            if v not in ("raise", "hang", "corrupt", "kill", "tear"):
                 raise ValueError(f"unknown injection mode {v!r}")
             kw["mode"] = v
         elif k == "kind":
@@ -203,12 +207,34 @@ class FaultInjector:
         with self._lock:
             plans = list(self._plans)
         for p in plans:
+            if p.mode in ("kill", "tear"):
+                continue  # crash plans fire only via crash_action
             if not p.matches(stage) or not p.should_fire():
                 continue
             if p.mode == "hang":
                 time.sleep(p.hang_s)  # a *slow* call: the watchdog decides
                 continue
             raise InjectedFault(p.kind, stage, p.calls)
+
+    def crash_action(self, stage: str) -> str | None:
+        """Called by crash-point hooks (``crashpoints.maybe_crash``) at
+        every persistence barrier. Counts the call on each matching
+        kill/tear plan and returns the mode of the first plan that fires
+        (``"kill"`` | ``"tear"``), else None. Counters are crash-plan
+        private: ``before_call`` never ticks them, so "the Nth persistence
+        op" is exact regardless of interleaved device-fault plans."""
+        self._ensure_env()
+        if not self._plans:
+            return None
+        with self._lock:
+            plans = list(self._plans)
+        action = None
+        for p in plans:
+            if p.mode not in ("kill", "tear") or not p.matches(stage):
+                continue
+            if p.should_fire() and action is None:
+                action = p.mode
+        return action
 
 
 injector = FaultInjector()

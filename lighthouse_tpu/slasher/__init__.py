@@ -108,4 +108,17 @@ def make_slasher(store=None, types=None, config: SlasherConfig | None = None,
         raw = os.environ.get("LIGHTHOUSE_SLASHER_HISTORY", "").strip()
         history = int(raw) if raw else SlasherConfig().history_length
         config = SlasherConfig(history_length=history)
-    return EngineSlasher(store, types, config, **kw)
+    slasher = EngineSlasher(store, types, config, **kw)
+    if store is not None:
+        # restart-from-disk: rehydrate the surveillance window from the
+        # last checkpoint (engine.persist) so pre-restart votes still
+        # convict a post-restart equivocator
+        try:
+            slasher.restore()
+        except Exception as e:  # noqa: BLE001 — corrupt checkpoint: start fresh
+            from ..utils.logging import get_logger
+
+            get_logger("slasher").warning(
+                "Slasher checkpoint restore failed", error=str(e)
+            )
+    return slasher

@@ -478,6 +478,7 @@ class EngineSlasher:
     ):
         self.config = config or SlasherConfig()
         self.config.validate()
+        self.store = store  # KV store for checkpoint persistence (optional)
         self.types = types
         use_device = None
         if backend is not None:
@@ -832,6 +833,209 @@ class EngineSlasher:
             del self._proposals[key]
             dropped += 1
         return dropped
+
+    # -- persistence (restart-from-disk, ISSUE 12) -------------------------
+
+    PERSIST_KEY = b"engine_v1"
+
+    def persist(self, store=None) -> bool:
+        """Checkpoint the record index + span planes into the KV store as
+        ONE atomic write (``SlasherMeta`` column, the reference's slasher
+        database tables collapsed into a compressed document).
+
+        This closes the restart window the ROADMAP flagged: pre-restart
+        votes used to live only in memory, so a determined equivocator
+        could vote once, wait for a restart, and vote again unseen. With
+        the checkpoint, the whole surveillance window (records + distance
+        planes + pending, unharvested slashings) survives a kill at any
+        persistence barrier. Planes are dense (8 B/validator-epoch before
+        compression) — the same sizing note as ``make_slasher``'s window
+        knob applies.
+        """
+        import base64
+        import json as _json
+        import zlib as _zlib
+
+        store = store if store is not None else self.store
+        if store is None:
+            return False
+        if self.span.host is None and self.span.dev is None:
+            # nothing swept yet (a service tick before the first batch):
+            # there are no planes to checkpoint, and treating the None as
+            # a device fault would demote a healthy engine
+            return False
+        # snapshot the index under the intake lock...
+        with self._lock:
+            t_att = self.types.IndexedAttestation
+            atts = {
+                str(i): t_att.encode(a).hex() for i, a in self._atts.items()
+            }
+            records = {
+                str(t): {str(v): i for v, i in rec.items()}
+                for t, rec in self._records.items()
+            }
+            proposals = [
+                type(h).encode(h).hex() for h in self._proposals.values()
+            ]
+            att_slashings = [
+                type(s).encode(s).hex()
+                for s in self._attester_slashings.values()
+            ]
+            prop_slashings = [
+                type(s).encode(s).hex()
+                for s in self._proposer_slashings.values()
+            ]
+            next_id = self._next_id
+        # ...but sync the span planes OUTSIDE it (device mode materializes
+        # the device arrays — a device call under the intake lock would
+        # stall the gossip observers)
+        try:
+            planes = self.span.planes()
+        except Exception as e:  # noqa: BLE001 — device fault during sync:
+            # demote-and-replay reconstructs the host truth losslessly
+            from ..resilience import faults
+
+            faults.record_fault("slasher.checkpoint", e, domain="slasher_device")
+            self.span._demote_and_replay()
+            planes = self.span.planes()
+        doc = {
+            "version": 1,
+            "history_length": self.config.history_length,
+            "next_id": next_id,
+            "atts": atts,
+            "records": records,
+            "proposals": proposals,
+            "attester_slashings": att_slashings,
+            "proposer_slashings": prop_slashings,
+            "span": {
+                "n": self.span.n,
+                "epoch": self.span.epoch,
+                "planes": [
+                    {
+                        "dtype": str(p.dtype),
+                        "shape": list(p.shape),
+                        "data": base64.b64encode(p.tobytes()).decode(),
+                    }
+                    for p in planes
+                ],
+            },
+        }
+        blob = _zlib.compress(_json.dumps(doc).encode(), 1)
+        from ..resilience.crashpoints import maybe_crash
+        from ..store.kv import DBColumn
+
+        maybe_crash("persist.slasher", owner=getattr(store, "owner", None))
+        store.put(DBColumn.SlasherMeta, self.PERSIST_KEY, blob)
+        return True
+
+    def restore(self, store=None) -> bool:
+        """Rehydrate the record index + span planes from a ``persist``
+        checkpoint. Derived maps (data roots, id<->root, per-target ids)
+        are recomputed from the decoded attestations, so the checkpoint
+        carries no redundant — and thus no possibly-inconsistent — state.
+        Returns False (untouched engine) when no/incompatible checkpoint
+        exists."""
+        import base64
+        import json as _json
+        import zlib as _zlib
+
+        import numpy as _np
+
+        from ..store.kv import DBColumn
+        from ..types.containers import AttestationData
+
+        store = store if store is not None else self.store
+        if store is None:
+            return False
+        blob = store.get(DBColumn.SlasherMeta, self.PERSIST_KEY)
+        if blob is None:
+            return False
+        try:
+            doc = _json.loads(_zlib.decompress(blob))
+        except Exception:  # noqa: BLE001 — corrupt checkpoint: fresh start
+            from ..utils.logging import get_logger
+
+            get_logger("slasher").warning("Slasher checkpoint unreadable")
+            return False
+        if doc.get("history_length") != self.config.history_length:
+            # window resize invalidates the planes' distance encoding
+            return False
+        t_att = self.types.IndexedAttestation
+        from ..types.containers import ProposerSlashing, SignedBeaconBlockHeader
+
+        # Decode the WHOLE checkpoint into locals before touching any engine
+        # state: one record failing to decode (schema drift, truncated blob)
+        # must leave the engine untouched per the contract above, not
+        # half-populated with ids no record/plane state references.
+        try:
+            atts = {}
+            for sid, hexed in doc["atts"].items():
+                att_id = int(sid)
+                att = t_att.decode(bytes.fromhex(hexed))
+                atts[att_id] = (
+                    att,
+                    t_att.hash_tree_root(att),
+                    AttestationData.hash_tree_root(att.data),
+                )
+            records = {
+                int(tgt): {int(v): int(i) for v, i in rec.items()}
+                for tgt, rec in doc["records"].items()
+            }
+            proposals = {}
+            for hexed in doc["proposals"]:
+                h = SignedBeaconBlockHeader.decode(bytes.fromhex(hexed))
+                proposals[
+                    (int(h.message.slot), int(h.message.proposer_index))
+                ] = h
+            att_slashings = {}
+            for hexed in doc["attester_slashings"]:
+                s = self.types.AttesterSlashing.decode(bytes.fromhex(hexed))
+                att_slashings[self.types.AttesterSlashing.hash_tree_root(s)] = s
+            prop_slashings = {}
+            for hexed in doc["proposer_slashings"]:
+                s = ProposerSlashing.decode(bytes.fromhex(hexed))
+                prop_slashings[ProposerSlashing.hash_tree_root(s)] = s
+            next_id = int(doc["next_id"])
+            span_doc = doc["span"]
+            planes = [
+                _np.frombuffer(
+                    base64.b64decode(p["data"]), dtype=_np.dtype(p["dtype"])
+                ).reshape(p["shape"]).copy()
+                for p in span_doc["planes"]
+            ]
+            n_pad = planes[0].shape[0]
+            span_n, span_epoch = int(span_doc["n"]), int(span_doc["epoch"])
+        except Exception:  # noqa: BLE001 — undecodable checkpoint: fresh start
+            from ..utils.logging import get_logger
+
+            get_logger("slasher").warning("Slasher checkpoint undecodable")
+            return False
+        with self._lock:
+            for att_id, (att, root, data_root) in atts.items():
+                self._atts[att_id] = att
+                self._att_root[att_id] = data_root
+                self._root_to_id[root] = att_id
+                self._id_to_root[att_id] = root
+                self._ids_by_target.setdefault(
+                    int(att.data.target.epoch), set()
+                ).add(att_id)
+            self._records.update(records)
+            self._proposals.update(proposals)
+            self._attester_slashings.update(att_slashings)
+            self._proposer_slashings.update(prop_slashings)
+            self._next_id = max(self._next_id, next_id)
+        span = self.span
+        span.host = planes
+        span.n = span_n
+        span.n_pad = n_pad
+        span.epoch = span_epoch
+        span.ckpt_epoch = span.epoch
+        span.journal.clear()
+        if span.use_device:
+            span.mode = "device" if span._try_upload() else "host"
+        else:
+            span.mode = "host"
+        return True
 
     # -- observability -----------------------------------------------------
 

@@ -60,4 +60,18 @@ class SlasherService:
                 self.op_pool.insert_attester_slashing(s)
             for s in self.slasher.get_proposer_slashings():
                 self.op_pool.insert_proposer_slashing(s)
+        # checkpoint the engine's record index + span planes each tick when
+        # a store is attached (restart-from-disk durability, ISSUE 12) —
+        # a persistence failure is recorded, never silently dropped, and
+        # the in-memory engine keeps serving
+        persist = getattr(self.slasher, "persist", None)
+        if persist is not None and getattr(self.slasher, "store", None) is not None:
+            try:
+                persist()
+            except Exception as e:  # noqa: BLE001 — durable tick best-effort
+                from ..resilience import faults
+
+                faults.record_fault(
+                    "slasher.persist", e, domain="slasher_device"
+                )
         return stats

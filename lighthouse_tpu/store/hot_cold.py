@@ -60,6 +60,40 @@ class HotColdDB:
         apply_schema_migrations(self)
         check_config_consistency(self, self.config.hierarchy.exponents)
 
+    # -- atomic batches ----------------------------------------------------------
+
+    def do_atomically(self, ops: list, db: str = "hot") -> None:
+        """One all-or-nothing batch against the hot (default) or cold KV
+        store (kv.KeyValueStore.do_atomically contract). The multi-key
+        sequences of block import and the finalization migration go through
+        here so a crash mid-sequence can never be observed after replay."""
+        (self.hot if db == "hot" else self.cold).do_atomically(ops)
+
+    def atomic_block_import(
+        self,
+        block_root: bytes,
+        signed_block_ssz: bytes,
+        state_root: bytes,
+        state_ssz: bytes,
+        slot: int,
+        extra_meta: dict | None = None,
+    ) -> None:
+        """The block-import barrier: block + post-state + slot summary
+        (+ any metadata riders) committed as ONE hot frame."""
+        ops = [
+            ("put", DBColumn.BeaconBlock, block_root, signed_block_ssz),
+            ("put", DBColumn.BeaconState, state_root, state_ssz),
+            (
+                "put",
+                DBColumn.BeaconStateSummary,
+                state_root,
+                int(slot).to_bytes(8, "little"),
+            ),
+        ]
+        for key, value in (extra_meta or {}).items():
+            ops.append(("put", DBColumn.Metadata, key, value))
+        self.hot.do_atomically(ops)
+
     # -- blocks -----------------------------------------------------------------
 
     def put_block(self, block_root: bytes, signed_block_ssz: bytes) -> None:
@@ -103,11 +137,18 @@ class HotColdDB:
     # -- hot states -------------------------------------------------------------
 
     def put_state(self, state_root: bytes, state_ssz: bytes, slot: int) -> None:
-        self.hot.put(DBColumn.BeaconState, state_root, state_ssz)
-        self.hot.put(
-            DBColumn.BeaconStateSummary,
-            state_root,
-            slot.to_bytes(8, "little"),
+        # state bytes + slot summary are one logical record: commit them as
+        # one frame so a crash can't leave a state without its summary
+        self.hot.do_atomically(
+            [
+                ("put", DBColumn.BeaconState, state_root, state_ssz),
+                (
+                    "put",
+                    DBColumn.BeaconStateSummary,
+                    state_root,
+                    slot.to_bytes(8, "little"),
+                ),
+            ]
         )
 
     def get_state(self, state_root: bytes) -> bytes | None:
@@ -121,8 +162,12 @@ class HotColdDB:
         return int.from_bytes(b, "little") if b else None
 
     def delete_state(self, state_root: bytes) -> None:
-        self.hot.delete(DBColumn.BeaconState, state_root)
-        self.hot.delete(DBColumn.BeaconStateSummary, state_root)
+        self.hot.do_atomically(
+            [
+                ("delete", DBColumn.BeaconState, state_root),
+                ("delete", DBColumn.BeaconStateSummary, state_root),
+            ]
+        )
 
     # -- cold states (freezer, hierarchical diffs) --------------------------------
 
@@ -143,36 +188,57 @@ class HotColdDB:
             # the replay layer has no reachable anchor below (skipped-slot
             # hole): store a diff at the finest layer instead of losing it
             strategy = DiffFrom(slot - slot % self.config.hierarchy.moduli[0])
+        # collect the freeze as ONE cold frame: state bytes (or diff) + both
+        # summary directions commit together — a crash mid-freeze can never
+        # leave a summary pointing at state bytes that were never written
+        ops = []
         if isinstance(strategy, Snapshot):
             ssz = type(state).encode(state)
-            self.cold.put(
-                DBColumn.ColdState,
-                self._slot_key(slot),
-                zlib.compress(ssz, self.config.compression_level),
+            ops.append(
+                (
+                    "put",
+                    DBColumn.ColdState,
+                    self._slot_key(slot),
+                    zlib.compress(ssz, self.config.compression_level),
+                )
             )
         elif isinstance(strategy, DiffFrom):
             base = self._cold_buffer(strategy.slot)
             if base is None:
                 # parent layer missing (pre-genesis-anchor history): snapshot
                 ssz = type(state).encode(state)
-                self.cold.put(
-                    DBColumn.ColdState,
-                    self._slot_key(slot),
-                    zlib.compress(ssz, self.config.compression_level),
+                ops.append(
+                    (
+                        "put",
+                        DBColumn.ColdState,
+                        self._slot_key(slot),
+                        zlib.compress(ssz, self.config.compression_level),
+                    )
                 )
             else:
                 target = HDiffBuffer.from_state(state)
                 diff = HDiff.compute(base, target)
-                self.cold.put(
-                    DBColumn.ColdStateDiff, self._slot_key(slot), diff.blob
+                ops.append(
+                    (
+                        "put",
+                        DBColumn.ColdStateDiff,
+                        self._slot_key(slot),
+                        diff.blob,
+                    )
                 )
         # ReplayFrom: state bytes not stored; the summary alone suffices
-        self.cold.put(
-            DBColumn.BeaconStateSummary,
-            self._slot_key(slot),
-            state_root + block_root,
+        ops.append(
+            (
+                "put",
+                DBColumn.BeaconStateSummary,
+                self._slot_key(slot),
+                state_root + block_root,
+            )
         )
-        self.cold.put(DBColumn.BeaconStateSummary, state_root, self._slot_key(slot))
+        ops.append(
+            ("put", DBColumn.BeaconStateSummary, state_root, self._slot_key(slot))
+        )
+        self.cold.do_atomically(ops)
         if slot > self.split.slot:
             self.split = Split(slot=slot, state_root=state_root)
 

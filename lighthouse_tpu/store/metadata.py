@@ -36,12 +36,17 @@ def _v1_to_v2(store) -> None:
     re-layered without replaying the chain, so they are DELETED — the v2
     freezer refills from finalization. Loud in-place removal beats silent
     misreads of root-keyed bytes through slot-keyed accessors."""
+    ops = []
     for col in (DBColumn.ColdState, DBColumn.ColdStateDiff):
         for key, _ in list(store.cold.iter_column(col)):
             if len(key) == 32:  # v1 root key (v2 keys are 8-byte slots)
-                store.cold.delete(col, key)
+                ops.append(("delete", col, key))
     for key, _ in list(store.cold.iter_column(DBColumn.BeaconStateSummary)):
-        store.cold.delete(DBColumn.BeaconStateSummary, key)
+        ops.append(("delete", DBColumn.BeaconStateSummary, key))
+    if ops:
+        # one atomic batch: a crash mid-migration must never leave a
+        # half-deleted v1 freezer behind a v2 version stamp
+        store.cold.do_atomically(ops)
 
 
 def apply_schema_migrations(store) -> None:

@@ -12,6 +12,15 @@ fault injector make a deterministic multi-node churn scenario —
 ``tests/test_resilience.py`` runs N slots under injected device faults,
 dropped gossip, and a node crash/restart, asserting liveness, zero
 false-verifies, and the drop-rate SLO.
+
+Crash-point harness (ISSUE 12): with ``datadir=`` every node persists into
+its own WAL-backed store, the ``mode=kill``/``mode=tear`` injection plans
+can kill a node at any persistence barrier mid-slot (``run_slot`` plays the
+OS: it catches ``InjectedCrash``, attributes it via the store's owner tag,
+and hard-crashes exactly that node), and ``restart_node(i, from_disk=True)``
+recovers chain + fork choice + op pool + slasher checkpoint from disk —
+``tests/test_crash_recovery.py`` sweeps the barriers and asserts the
+recovery invariants.
 """
 
 from __future__ import annotations
@@ -36,11 +45,18 @@ from .harness import StateHarness
 
 class LocalNetwork:
     def __init__(self, spec: ChainSpec, n_nodes: int, n_validators: int,
-                 transport: str = "loopback", slasher: bool = False):
+                 transport: str = "loopback", slasher: bool = False,
+                 datadir: str | None = None):
         assert n_validators % n_nodes == 0
         self.spec = spec
         self.mode = transport
         self.slasher_enabled = slasher
+        # per-node datadirs (loopback mode): each node persists into its
+        # own WAL-backed hot/cold store, making restart_node(from_disk=True)
+        # — and the crash-point sweep killing nodes at persistence barriers
+        # — possible. None keeps the seed's in-memory stores.
+        self.datadir = datadir
+        self.recovery_reports: list[dict] = []  # one per from-disk restart
         self.dead: set[int] = set()   # crashed node indices (chaos harness)
         self.missed_proposals = 0     # invalid-on-own-chain proposals skipped
         self._chaos_seen = False      # any crash/loss ever armed this run
@@ -54,6 +70,9 @@ class LocalNetwork:
         self.owned: list[range] = []
         if transport == "loopback":
             self.transport = LoopbackTransport()
+            # a recipient's barrier firing mid-delivery kills THAT node
+            # only; the publisher's fan-out continues (kill -9 semantics)
+            self.transport.on_injected_crash = self._on_injected_crash
             for i in range(n_nodes):
                 svc = BeaconNodeService(
                     f"node_{i}",
@@ -62,6 +81,7 @@ class LocalNetwork:
                     self.transport,
                     slot_clock=self.clock,
                     execution_layer=self.harness.el,
+                    chain=self._make_chain(i),
                 )
                 self.nodes.append(svc)
                 self.owned.append(range(i * per, (i + 1) * per))
@@ -111,16 +131,59 @@ class LocalNetwork:
                 self._attach_slasher(svc)
         self._msg_total = 0  # messages published so far (settle accounting)
 
+    def _make_store(self, i: int):
+        """Per-node WAL-backed hot/cold store under ``datadir`` (or None).
+        fsync stays off — the chaos harness tears writes at the WAL frame
+        layer deterministically; it does not simulate power loss — and the
+        ``owner`` tag lets ``InjectedCrash`` name the node that died."""
+        if self.datadir is None:
+            return None
+        import os
+
+        from ..store.hot_cold import HotColdDB, StoreConfig
+        from ..store.kv import LevelStore
+
+        d = os.path.join(self.datadir, f"node_{i}")
+        return HotColdDB(
+            hot=LevelStore(
+                os.path.join(d, "chain.db"), fsync=False, owner=f"node_{i}"
+            ),
+            cold=LevelStore(
+                os.path.join(d, "freezer.db"), fsync=False, owner=f"node_{i}"
+            ),
+            config=StoreConfig(),
+        )
+
+    def _make_chain(self, i: int):
+        """A chain over the node's durable store, or None (the service
+        builds its own in-memory chain — the seed behavior)."""
+        store = self._make_store(i)
+        if store is None:
+            return None
+        from ..beacon_chain.chain import BeaconChain
+
+        return BeaconChain(
+            self.spec,
+            self.harness.state.copy(),
+            store=store,
+            slot_clock=self.clock,
+            execution_layer=self.harness.el,
+        )
+
     def _attach_slasher(self, svc) -> None:
         """Per-node slasher service on the chain's ingest seams: every
         gossip-verified attestation and every imported block (gossip AND
         range sync) flows into the engine; ``run_slot`` ticks it so found
         slashings drain into the node's op pool and ride the next proposal
-        (the full gossip -> slasher -> op_pool -> block-inclusion loop)."""
+        (the full gossip -> slasher -> op_pool -> block-inclusion loop).
+        With per-node datadirs the engine checkpoints into the node's hot
+        store each tick and ``make_slasher`` restores the checkpoint on a
+        from-disk restart — pre-restart votes still convict."""
         from ..slasher import SlasherConfig, SlasherService, make_slasher
 
         sl = make_slasher(
-            None, svc.chain.ns,
+            svc.chain.store.hot if self.datadir is not None else None,
+            svc.chain.ns,
             SlasherConfig(validator_chunk_size=16, history_length=64),
         )
         svc.slasher_service = SlasherService(svc.chain, sl, svc.op_pool)
@@ -212,20 +275,57 @@ class LocalNetwork:
                 except ConnectionError:
                     pass
 
-    def restart_node(self, i: int) -> None:
-        """Restart node ``i`` from genesis state under the same id (the
-        datadir-wiped worst case) and status-handshake every live peer —
-        range sync walks it back to the head, exactly the partitioned-node
-        recovery path."""
+    def restart_node(self, i: int, from_disk: bool = False) -> None:
+        """Restart node ``i`` under the same id and status-handshake every
+        live peer.
+
+        ``from_disk=False``: restart from genesis state (the datadir-wiped
+        worst case) — range sync walks it back to the head, exactly the
+        partitioned-node recovery path.
+
+        ``from_disk=True`` (needs ``datadir``): reopen the node's stores —
+        WAL replay truncates any torn tail — and rebuild chain + fork
+        choice + op pool (+ the slasher checkpoint via ``_attach_slasher``)
+        through ``beacon_chain.recovery``: the node comes back AT its last
+        persisted head, no range sync from genesis. The recovery report is
+        appended to ``self.recovery_reports``."""
         assert i in self.dead, f"node {i} is not crashed"
-        svc = BeaconNodeService(
-            f"node_{i}",
-            self.spec,
-            self.harness.state.copy(),
-            self.transport,
-            slot_clock=self.clock,
-            execution_layer=self.harness.el,
-        )
+        if from_disk:
+            assert self.datadir is not None, "from_disk needs datadirs"
+            old_store = self.nodes[i].chain.store
+            for kv in (old_store.hot, old_store.cold):
+                try:
+                    kv.close()  # release the dead process's file handles
+                except Exception:  # noqa: BLE001 — already torn/closed
+                    pass
+            from ..beacon_chain.recovery import recover_node_state
+
+            chain, op_pool, report = recover_node_state(
+                self.spec,
+                self.harness.state.copy(),
+                self._make_store(i),
+                slot_clock=self.clock,
+                execution_layer=self.harness.el,
+            )
+            self.recovery_reports.append(report)
+            svc = BeaconNodeService(
+                f"node_{i}",
+                self.spec,
+                transport=self.transport,
+                chain=chain,
+                op_pool=op_pool,
+            )
+        else:
+            # genesis restart deliberately ignores any datadir (it models
+            # the wiped-disk case): in-memory stores, range sync rebuilds
+            svc = BeaconNodeService(
+                f"node_{i}",
+                self.spec,
+                self.harness.state.copy(),
+                self.transport,
+                slot_clock=self.clock,
+                execution_layer=self.harness.el,
+            )
         self.nodes[i] = svc
         self.dead.discard(i)
         if self.slasher_enabled:
@@ -283,60 +383,104 @@ class LocalNetwork:
         self._msg_total += 1
 
     def _attest(self, slot: int) -> None:
-        spec = self.spec
-        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        # per-node guard: one attester dying at its own barrier must not
+        # cost the OTHER nodes their attestations for the slot
         for i, (node, owned) in enumerate(zip(self.nodes, self.owned)):
             if i in self.dead:
                 continue
-            state = node.chain.head.state
-            if state.slot < slot:
-                state = state.copy()
-                process_slots(spec, state, slot)
-            head_root = node.chain.head.root
-            target_root = (
-                head_root
-                if slot == spec.start_slot(epoch)
-                else _block_root_at(spec, state, spec.start_slot(epoch))
+            self._guarded(self._attest_node, node, owned, slot)
+
+    def _attest_node(self, node, owned, slot: int) -> None:
+        spec = self.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        state = node.chain.head.state
+        if state.slot < slot:
+            state = state.copy()
+            process_slots(spec, state, slot)
+        head_root = node.chain.head.root
+        target_root = (
+            head_root
+            if slot == spec.start_slot(epoch)
+            else _block_root_at(spec, state, spec.start_slot(epoch))
+        )
+        domain = get_domain(
+            spec, state, spec.DOMAIN_BEACON_ATTESTER, epoch=epoch
+        )
+        for index in range(get_committee_count_per_slot(spec, state, epoch)):
+            committee = get_beacon_committee(spec, state, slot, index)
+            data = AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=target_root),
             )
-            domain = get_domain(
-                spec, state, spec.DOMAIN_BEACON_ATTESTER, epoch=epoch
-            )
-            for index in range(get_committee_count_per_slot(spec, state, epoch)):
-                committee = get_beacon_committee(spec, state, slot, index)
-                data = AttestationData(
-                    slot=slot,
-                    index=index,
-                    beacon_block_root=head_root,
-                    source=state.current_justified_checkpoint,
-                    target=Checkpoint(epoch=epoch, root=target_root),
+            root = compute_signing_root(data, domain)
+            for pos, v in enumerate(committee):
+                if int(v) not in owned:
+                    continue
+                bits = np.zeros(committee.size, dtype=bool)
+                bits[pos] = True
+                att = node.chain.ns.Attestation(
+                    aggregation_bits=bits,
+                    data=data,
+                    signature=self.harness._sign(int(v), root),
                 )
-                root = compute_signing_root(data, domain)
-                for pos, v in enumerate(committee):
-                    if int(v) not in owned:
-                        continue
-                    bits = np.zeros(committee.size, dtype=bool)
-                    bits[pos] = True
-                    att = node.chain.ns.Attestation(
-                        aggregation_bits=bits,
-                        data=data,
-                        signature=self.harness._sign(int(v), root),
-                    )
-                    node.op_pool.insert_attestation(att)
-                    node.publish_attestation(att)
-                    self._msg_total += 1
+                node.op_pool.insert_attestation(att)
+                node.publish_attestation(att)
+                self._msg_total += 1
+
+    # -- crash-point attribution (ISSUE 12) --------------------------------
+
+    def _on_injected_crash(self, exc) -> int:
+        """An ``InjectedCrash`` surfaced mid-slot: the "operating system"
+        half of the harness. The owner tag (set on each node's WAL stores)
+        names the node whose persistence barrier fired; that node is
+        hard-crashed and the slot continues for everyone else."""
+        owner = getattr(exc, "owner", None)
+        if not owner or not owner.startswith("node_"):
+            raise exc  # unattributable: not a per-node store barrier
+        i = int(owner.split("_", 1)[1])
+        if i not in self.dead:
+            self.crash_node(i)
+        return i
+
+    def _guarded(self, fn, *args) -> None:
+        from ..resilience import InjectedCrash
+
+        try:
+            fn(*args)
+        except InjectedCrash as e:
+            self._on_injected_crash(e)
+
+    def _persist_pools(self) -> None:
+        """Durable-datadir cadence: each live node checkpoints its op pool
+        once per slot (the ``persist.op_pool`` barrier; fork choice and the
+        block/state batch persist inside the import path itself)."""
+        from ..op_pool import persistence as pool_persist
+
+        for i, node in enumerate(self.nodes):
+            if i not in self.dead:
+                # per-node guard: node i dying at its op-pool barrier must
+                # not skip the checkpoint of the nodes after it
+                self._guarded(
+                    pool_persist.persist, node.chain.store, node.op_pool
+                )
 
     def run_slot(self, slot: int) -> None:
         self.clock.set_slot(slot)
-        self._propose(slot)
+        self._guarded(self._propose, slot)
         self.settle()
-        self._attest(slot)
+        self._attest(slot)  # guards per node internally
         self.settle()
         if self.slasher_enabled:
             epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
             for i, node in enumerate(self.nodes):
                 svc = getattr(node, "slasher_service", None)
                 if i not in self.dead and svc is not None:
-                    svc.tick(current_epoch=epoch)
+                    self._guarded(svc.tick, epoch)
+        if self.datadir is not None:
+            self._persist_pools()  # guards per node internally
 
     def run_until(self, last_slot: int, start: int = 1) -> None:
         for slot in range(start, last_slot + 1):

@@ -280,6 +280,22 @@ RESILIENCE_WATCHDOG_TIMEOUTS = REGISTRY.counter(
     "Supervised calls that blew the watchdog deadline (hangs)",
     label_names=("domain", "stage"),
 )
+RESILIENCE_RECOVERIES = REGISTRY.counter(
+    "resilience_recoveries_total",
+    "Restart-from-disk recoveries (beacon_chain/recovery.py)",
+)
+RESILIENCE_RECOVERY_REPLAYED = REGISTRY.counter(
+    "resilience_recovery_replayed_records_total",
+    "WAL records replayed across restart-from-disk recoveries",
+)
+RESILIENCE_RECOVERY_TRUNCATED = REGISTRY.counter(
+    "resilience_recovery_truncated_bytes_total",
+    "Torn-tail bytes truncated by WAL replay across recoveries",
+)
+RESILIENCE_RECOVERY_TIMES = REGISTRY.histogram(
+    "resilience_recovery_seconds",
+    "Restart-from-disk recovery wall clock (store replay -> serving head)",
+)
 SLASHER_CHUNKS_UPDATED = REGISTRY.counter(
     "slasher_chunks_updated_total",
     "Slasher target-array rows updated (slasher/src/metrics.rs)",

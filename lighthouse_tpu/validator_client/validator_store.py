@@ -99,6 +99,12 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_block_proposal(
             bytes(pubkey), int(block.slot), root
         )
+        # crash point BETWEEN the recorded watermark and the signature
+        # leaving this process: the EIP-3076 record is committed first, so
+        # a kill here can never lead to a conflicting re-sign after restart
+        from ..resilience.crashpoints import maybe_crash
+
+        maybe_crash("persist.slashing_protection")
         return method.sign(root)
 
     def sign_attestation(self, pubkey: bytes, data, state) -> bls.Signature:
@@ -111,6 +117,9 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             bytes(pubkey), int(data.source.epoch), int(data.target.epoch), root
         )
+        from ..resilience.crashpoints import maybe_crash
+
+        maybe_crash("persist.slashing_protection")
         return method.sign(root)
 
     def sign_randao(self, pubkey: bytes, epoch: int, state) -> bls.Signature:

@@ -121,6 +121,34 @@ def test_client_builder_full_node_with_vc_loop():
         client.stop()
 
 
+def test_interop_genesis_reused_across_restart(tmp_path, monkeypatch):
+    """Restart-from-disk needs the SAME genesis on every boot: the first
+    boot records its interop genesis time in the datadir, and a later boot
+    (different wall clock) re-derives the identical anchor — otherwise the
+    persisted chain is foreign and recovery silently degrades to genesis."""
+    spec = minimal_spec()
+
+    def build(now):
+        monkeypatch.setattr("lighthouse_tpu.client.time.time", lambda: now)
+        cfg = ClientConfig(
+            datadir=str(tmp_path), interop_validators=8,
+            use_system_clock=False,
+        )
+        return ClientBuilder(spec, cfg).build()
+
+    c1 = build(1_000_000)
+    root1 = bytes(c1.chain.genesis_block_root)
+    assert int(c1.chain.head.state.genesis_time) == 1_000_000
+    for kv in (c1.chain.store.hot, c1.chain.store.cold):
+        kv.close()
+
+    c2 = build(2_000_000)  # "rebooted" much later
+    assert int(c2.chain.head.state.genesis_time) == 1_000_000
+    assert bytes(c2.chain.genesis_block_root) == root1
+    for kv in (c2.chain.store.hot, c2.chain.store.cold):
+        kv.close()
+
+
 def test_bn_datadir_persistence(tmp_path):
     """run_bn writes durable stores under --datadir."""
     p = build_parser()

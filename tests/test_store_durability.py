@@ -1,0 +1,423 @@
+"""Crash-safe store durability (ISSUE 12).
+
+The WAL layer under test, bottom-up:
+
+* framed commits: put/delete/do_atomically each one checksummed frame,
+  replayed exactly on reopen;
+* torn-tail truncation: a kill mid-write (simulated byte-exactly by the
+  ``mode=tear`` injection, and by hand-truncating/corrupting the file)
+  must surface NONE of the torn batch and keep everything before it;
+* crash-safe compaction: a kill anywhere in the ``.compact`` + ``os.replace``
+  window leaves either the old log or the new one — a leftover tmp file is
+  ignored and removed on reopen, never replayed;
+* the pre-WAL (unframed) format is detected and upgraded in place;
+* ``do_atomically`` is all-or-nothing on EVERY backend, including against
+  malformed batches (stage-then-commit, never mutate-then-raise).
+"""
+
+import os
+import struct
+import zlib
+
+import pytest
+
+from lighthouse_tpu.resilience import InjectedCrash, injector
+from lighthouse_tpu.store.kv import (
+    _COMMIT,
+    _FRAME_HDR,
+    _FRAME_MAGIC,
+    DBColumn,
+    KeyValueStore,
+    LevelStore,
+    MemoryStore,
+)
+
+C = DBColumn.Metadata
+B = DBColumn.BeaconBlock
+
+
+@pytest.fixture(autouse=True)
+def _inert_injector():
+    injector.clear()
+    yield
+    injector.clear()
+
+
+@pytest.fixture()
+def path(tmp_path):
+    return str(tmp_path / "wal.db")
+
+
+class TestWalBasics:
+    def test_round_trip_and_reopen(self, path):
+        s = LevelStore(path)
+        s.put(C, b"a", b"1")
+        s.put(C, b"b", b"two")
+        s.put(B, b"a", b"other-column")
+        s.delete(C, b"a")
+        s.put(C, b"b", b"TWO")  # overwrite
+        s.close()
+        s = LevelStore(path)
+        assert s.get(C, b"a") is None
+        assert s.get(C, b"b") == b"TWO"
+        assert s.get(B, b"a") == b"other-column"
+        assert list(s.iter_column(C)) == [(b"b", b"TWO")]
+        assert s.recovery_stats["truncated_bytes"] == 0
+        assert s.recovery_stats["replayed_records"] >= 5
+        s.close()
+
+    def test_do_atomically_is_one_frame(self, path):
+        s = LevelStore(path)
+        s.put(C, b"pre", b"x")
+        s.do_atomically(
+            [
+                ("put", B, b"blk", b"blockbytes"),
+                ("put", C, b"meta", b"metabytes"),
+                ("delete", C, b"pre"),
+            ]
+        )
+        frames = s.recovery_stats  # noqa: F841 — replay stats are reopen-side
+        s.close()
+        s = LevelStore(path)
+        assert s.get(B, b"blk") == b"blockbytes"
+        assert s.get(C, b"meta") == b"metabytes"
+        assert s.get(C, b"pre") is None
+        s.close()
+
+    def test_torn_tail_truncated_batch_invisible(self, path):
+        s = LevelStore(path)
+        s.put(C, b"keep", b"kept")
+        s.do_atomically(
+            [("put", C, b"t1", b"v1"), ("put", C, b"t2", b"v2")]
+        )
+        s.close()
+        # tear the LAST frame a few bytes short of its commit marker: the
+        # batch was never committed, so NEITHER key may survive
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 3)
+        s = LevelStore(path)
+        assert s.get(C, b"keep") == b"kept"
+        assert s.get(C, b"t1") is None
+        assert s.get(C, b"t2") is None
+        assert s.recovery_stats["truncated_bytes"] > 0
+        # the torn bytes are gone from disk too: appends stay clean
+        s.put(C, b"after", b"ok")
+        s.close()
+        s = LevelStore(path)
+        assert s.get(C, b"after") == b"ok"
+        assert s.recovery_stats["truncated_bytes"] == 0
+        s.close()
+
+    def test_sub_header_file_is_a_torn_tail(self, path):
+        # a power cut after the file was created but before the first 4
+        # bytes landed can leave ANY byte count; < 4 bytes is neither a
+        # frame header nor a legacy record — truncate, don't crash the open
+        with open(path, "wb") as f:
+            f.write(b"\x01\x02")
+        s = LevelStore(path)
+        assert s.recovery_stats["truncated_bytes"] == 2
+        assert s.recovery_stats["replayed_records"] == 0
+        s.put(C, b"k", b"v")
+        s.close()
+        s = LevelStore(path)
+        assert s.get(C, b"k") == b"v"
+        assert s.recovery_stats["truncated_bytes"] == 0
+        s.close()
+
+    def test_corrupt_commit_marker_rejected(self, path):
+        s = LevelStore(path)
+        s.put(C, b"keep", b"kept")
+        s.put(C, b"bad", b"payload")
+        s.close()
+        # flip a payload byte of the last frame: record + commit checksums
+        # both now mismatch -> the frame is discarded
+        with open(path, "r+b") as f:
+            data = f.read()
+            f.seek(len(data) - _COMMIT.size - 2)
+            f.write(b"\xff")
+        s = LevelStore(path)
+        assert s.get(C, b"keep") == b"kept"
+        assert s.get(C, b"bad") is None
+        assert s.recovery_stats["truncated_bytes"] > 0
+        s.close()
+
+    def test_kill_injection_op_never_happened(self, path):
+        s = LevelStore(path, owner="node_7")
+        s.put(C, b"a", b"1")
+        injector.install("stage=store.commit;mode=kill;at=2")
+        s.put(C, b"b", b"2")  # call #1 of the plan: no fire
+        with pytest.raises(InjectedCrash) as ei:
+            s.put(C, b"c", b"3")
+        assert ei.value.owner == "node_7"
+        assert ei.value.stage == "store.commit"
+        injector.clear()
+        s2 = LevelStore(path)
+        assert s2.get(C, b"b") == b"2"
+        assert s2.get(C, b"c") is None
+        assert s2.recovery_stats["truncated_bytes"] == 0  # kill never tears
+        s2.close()
+
+    def test_tear_injection_truncated_on_replay(self, path):
+        s = LevelStore(path)
+        s.put(C, b"a", b"1")
+        injector.install("stage=store.commit;mode=tear;at=1")
+        with pytest.raises(InjectedCrash) as ei:
+            s.do_atomically(
+                [("put", C, b"x", b"big" * 50), ("put", C, b"y", b"2")]
+            )
+        assert ei.value.torn
+        injector.clear()
+        s2 = LevelStore(path)
+        assert s2.get(C, b"a") == b"1"
+        assert s2.get(C, b"x") is None
+        assert s2.get(C, b"y") is None
+        assert s2.recovery_stats["truncated_bytes"] > 0
+        s2.close()
+
+    def test_fsync_mode_smoke(self, path):
+        s = LevelStore(path, fsync=True)
+        s.put(C, b"a", b"1")
+        s.compact()
+        assert s.get(C, b"a") == b"1"
+        s.close()
+
+
+@pytest.mark.chaos
+class TestCompactionCrash:
+    """A crash ANYWHERE in the compact window must be recoverable, and a
+    leftover ``.compact`` tmp file must be ignored/cleaned on reopen,
+    never replayed (the satellite bugfix: the seed's ``os.replace`` window
+    assumed it always completed)."""
+
+    def _seed(self, path):
+        s = LevelStore(path)
+        for i in range(8):
+            s.put(C, b"k%d" % i, b"v%d" % i)
+        s.delete(C, b"k0")
+        return s
+
+    def _assert_intact(self, path):
+        s = LevelStore(path)
+        assert not os.path.exists(path + ".compact")
+        assert s.get(C, b"k0") is None
+        for i in range(1, 8):
+            assert s.get(C, b"k%d" % i) == b"v%d" % i
+        s.close()
+        return True
+
+    def test_kill_before_compact_write(self, path):
+        s = self._seed(path)
+        injector.install("stage=store.compact;mode=kill;at=1")
+        with pytest.raises(InjectedCrash):
+            s.compact()
+        injector.clear()
+        assert self._assert_intact(path)
+
+    def test_kill_in_replace_window_leftover_ignored(self, path):
+        s = self._seed(path)
+        injector.install("stage=store.compact.replace;mode=kill;at=1")
+        with pytest.raises(InjectedCrash):
+            s.compact()
+        injector.clear()
+        # a COMPLETE .compact exists beside the authoritative log...
+        assert os.path.exists(path + ".compact")
+        # ...and reopen removes it without replaying it
+        s2 = LevelStore(path)
+        assert s2.recovery_stats["stale_compact_removed"] == 1
+        s2.close()
+        assert self._assert_intact(path)
+
+    def test_tear_in_replace_window_degrades_to_kill(self, path):
+        """The replace window owns no byte stream (os.replace is
+        all-or-nothing): a tear plan there must still CRASH — consuming
+        the plan without dying would let the sweep report a barrier green
+        without ever exercising it."""
+        s = self._seed(path)
+        injector.install("stage=store.compact.replace;mode=tear;at=1")
+        with pytest.raises(InjectedCrash) as ei:
+            s.compact()
+        assert not ei.value.torn  # degraded to a clean kill
+        injector.clear()
+        assert os.path.exists(path + ".compact")
+        assert self._assert_intact(path)
+
+    def test_tear_during_compact_write(self, path):
+        """mode=tear at the compact barrier dies half-way through the tmp
+        write; the torn .compact is discarded on reopen."""
+        s = self._seed(path)
+        injector.install("stage=store.compact;mode=tear;at=1")
+        with pytest.raises(InjectedCrash) as ei:
+            s.compact()
+        assert ei.value.torn
+        injector.clear()
+        assert os.path.exists(path + ".compact")
+        assert self._assert_intact(path)
+
+    def test_tear_degrades_to_kill_at_non_stream_barrier(self):
+        """Semantic barriers own no byte stream: a tear plan there kills
+        cleanly instead of silently doing nothing."""
+        from lighthouse_tpu.resilience.crashpoints import maybe_crash
+
+        injector.install("stage=persist.fork_choice;mode=tear;at=1")
+        with pytest.raises(InjectedCrash) as ei:
+            maybe_crash("persist.fork_choice", owner="node_3")
+        assert not ei.value.torn
+        assert ei.value.owner == "node_3"
+
+    def test_partial_compact_tmp_never_replayed(self, path):
+        """A hand-torn (half-written) .compact must also be discarded."""
+        s = self._seed(path)
+        s.close()
+        # fabricate the partial tmp a kill mid-compact-write leaves: a
+        # frame header promising records that never arrived
+        with open(path + ".compact", "wb") as f:
+            f.write(_FRAME_HDR.pack(_FRAME_MAGIC, 999, 10_000))
+            f.write(b"\x00" * 17)
+        assert self._assert_intact(path)
+
+    def test_compact_then_reopen_round_trip(self, path):
+        s = self._seed(path)
+        s.compact()
+        s.put(C, b"post", b"compaction-append")
+        s.close()
+        s2 = LevelStore(path)
+        assert s2.get(C, b"post") == b"compaction-append"
+        assert s2.get(C, b"k3") == b"v3"
+        s2.close()
+
+
+class TestAutoCompaction:
+    def test_overwrite_heavy_log_stays_bounded(self, path):
+        """A full-checkpoint writer (the slasher persists its planes every
+        tick) overwrites one key per slot: without auto-compaction the log
+        grows by a dead frame per write, forever."""
+        s = LevelStore(path)
+        s.AUTO_COMPACT_MIN_BYTES = 4096
+        blob = bytes(600)
+        for _ in range(64):
+            s.put(C, b"ckpt", blob)
+        assert os.path.getsize(path) < 2 * s.AUTO_COMPACT_MIN_BYTES
+        assert s.get(C, b"ckpt") == blob
+        s.close()
+        s = LevelStore(path)
+        assert s.get(C, b"ckpt") == blob
+        s.close()
+
+    def test_auto_compact_can_be_disabled(self, path):
+        s = LevelStore(path, auto_compact=False)
+        s.AUTO_COMPACT_MIN_BYTES = 4096
+        for _ in range(64):
+            s.put(C, b"ckpt", bytes(600))
+        assert os.path.getsize(path) > 8 * 4096  # append-only growth
+        s.close()
+
+
+class TestLegacyUpgrade:
+    def test_pre_wal_log_detected_and_rewritten(self, path):
+        # the seed's unframed [op][klen][vlen][key][val] stream
+        with open(path, "wb") as f:
+            for key, val in ((b"a", b"old-1"), (b"b", b"old-2")):
+                k = C.value + b"/" + key
+                f.write(struct.pack("<BII", 1, len(k), len(val)) + k + val)
+            k = C.value + b"/a"
+            f.write(struct.pack("<BII", 2, len(k), 0) + k)  # delete a
+        s = LevelStore(path)
+        assert s.recovery_stats["legacy_upgraded"]
+        assert s.get(C, b"a") is None
+        assert s.get(C, b"b") == b"old-2"
+        s.put(C, b"new", b"framed")
+        s.close()
+        # the rewritten file is pure WAL frames now
+        with open(path, "rb") as f:
+            assert struct.unpack("<I", f.read(4))[0] == _FRAME_MAGIC
+        s2 = LevelStore(path)
+        assert not s2.recovery_stats["legacy_upgraded"]
+        assert s2.get(C, b"b") == b"old-2"
+        assert s2.get(C, b"new") == b"framed"
+        s2.close()
+
+
+class TestAtomicContract:
+    """The base ``do_atomically`` contract (the satellite bugfix): a batch
+    is validated before ANY mutation, on every backend."""
+
+    @pytest.mark.parametrize("make", [MemoryStore, None], ids=["memory", "level"])
+    def test_malformed_batch_leaves_store_untouched(self, make, path):
+        s = make() if make is not None else LevelStore(path)
+        s.put(C, b"a", b"1")
+        with pytest.raises(ValueError):
+            s.do_atomically(
+                [("put", C, b"b", b"2"), ("frobnicate", C, b"c")]
+            )
+        assert s.get(C, b"b") is None  # nothing from the bad batch
+        assert s.get(C, b"a") == b"1"
+        with pytest.raises((ValueError, TypeError)):
+            s.do_atomically([("put", C, b"d")])  # missing value
+        assert s.get(C, b"d") is None
+
+    def test_memory_batch_visible_atomically(self):
+        s = MemoryStore()
+        s.put(C, b"x", b"old")
+        s.do_atomically(
+            [
+                ("put", C, b"x", b"new"),
+                ("put", B, b"y", b"1"),
+                ("delete", B, b"nope"),
+            ]
+        )
+        assert s.get(C, b"x") == b"new"
+        assert s.get(B, b"y") == b"1"
+
+    def test_base_class_validates_before_dispatch(self):
+        calls = []
+
+        class Recording(KeyValueStore):
+            def put(self, col, key, val):
+                calls.append(("put", key))
+
+            def delete(self, col, key):
+                calls.append(("del", key))
+
+        with pytest.raises(ValueError):
+            Recording().do_atomically(
+                [("put", C, b"k", b"v"), ("bogus",)]
+            )
+        assert calls == []  # validation ran before the first dispatch
+
+
+class TestHotColdAtomicSeams:
+    def test_put_state_is_one_frame(self, path):
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+
+        db = HotColdDB(hot=LevelStore(path))
+        injector.install("stage=store.commit;mode=tear;every=1")
+        with pytest.raises(InjectedCrash):
+            db.put_state(b"\x01" * 32, b"state-bytes", 7)
+        injector.clear()
+        db.hot.close()
+        hot = LevelStore(path)
+        # neither the state bytes nor the summary survived: no torn pair
+        assert hot.get(DBColumn.BeaconState, b"\x01" * 32) is None
+        assert hot.get(DBColumn.BeaconStateSummary, b"\x01" * 32) is None
+        hot.close()
+
+    def test_atomic_block_import_all_or_nothing(self, path):
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+
+        db = HotColdDB(hot=LevelStore(path))
+        db.atomic_block_import(b"\x0b" * 32, b"blk", b"\x05" * 32, b"st", 3)
+        assert db.get_block(b"\x0b" * 32) == b"blk"
+        assert db.state_slot(b"\x05" * 32) == 3
+        injector.install("stage=store.commit;mode=kill;every=1")
+        with pytest.raises(InjectedCrash):
+            db.atomic_block_import(
+                b"\x0c" * 32, b"blk2", b"\x06" * 32, b"st2", 4
+            )
+        injector.clear()
+        db.hot.close()
+        hot = LevelStore(path)
+        assert hot.get(DBColumn.BeaconBlock, b"\x0c" * 32) is None
+        assert hot.get(DBColumn.BeaconState, b"\x06" * 32) is None
+        assert hot.get(DBColumn.BeaconBlock, b"\x0b" * 32) == b"blk"
+        hot.close()
