@@ -529,6 +529,23 @@ def _enable_compile_cache():
     import lighthouse_tpu  # noqa: F401
 
 
+def _backend_stamp() -> dict:
+    """Conv-backend + jax-version stamp for every rung record (ISSUE 13):
+    pallas / digits / f64 run DIFFERENT kernels with different perf
+    envelopes, and a jax upgrade changes the pallas lowering — records from
+    different backends must be distinguishable and must never silently
+    overwrite each other (tools_tpu_hunter keys its best-record files by
+    conv_impl)."""
+    try:
+        import jax
+
+        from lighthouse_tpu.ops.bls import fq
+
+        return {"conv_impl": fq.conv_backend(), "jax_version": jax.__version__}
+    except Exception:  # noqa: BLE001 — the stamp must never fail a record
+        return {"conv_impl": "unknown", "jax_version": "unknown"}
+
+
 def _resilience_summary() -> dict | None:
     """Fault-domain integrity stamp for every rung record (ISSUE 7): the
     supervisor snapshot proves whether any part of the measurement was
@@ -597,6 +614,7 @@ def _inner():
                 "unit": "sets/s",
                 "vs_baseline": round(dev / native, 3),
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "shape": {
                     "sets": N_SETS,
@@ -745,6 +763,7 @@ def _inner_firehose():
                 "value": round(st.verified / wall, 2),
                 "unit": "att/s",
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "stream": {
                     "offered_att_per_s": rate,
@@ -982,6 +1001,7 @@ def _inner_firehose_sharded():
                 "value": sharded_rec["att_per_s"],
                 "unit": "att/s",
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "n_devices": n_dev,
                 "shard_batch": shard_batch,
@@ -1077,6 +1097,7 @@ def _inner_h2c():
                 "value": round(n * iters / dt, 2),
                 "unit": "points/s",
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "shape": {"batch": n},
                 "stages_ms_per_batch": {
@@ -1169,6 +1190,7 @@ def _inner_pairing():
                 "value": round(n * iters / dt, 2),
                 "unit": "sets/s",
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "shape": {"batch": n, "pairs": n + 1},
                 "stages_ms_per_batch": {
@@ -1333,6 +1355,7 @@ def _inner_epoch():
                     round(value / numpy_v_per_s, 3) if numpy_v_per_s else None
                 ),
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "n_devices": n_dev,
                 "sharded": sharding is not None,
@@ -1472,6 +1495,7 @@ def _inner_slasher():
                     round(value / numpy_c_per_s, 3) if numpy_c_per_s else None
                 ),
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "n_devices": n_dev,
                 "sharded": sharding is not None,
@@ -1608,14 +1632,32 @@ def _hunter_record(mode: str = "sets") -> dict | None:
         "pairing": "tpu_pairing_record.json",
         "slasher": "tpu_slasher_record.json",
     }.get(mode, "tpu_record.json")
-    path = os.path.join(_CACHE_DIR, name)
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
+    # the hunter keys its best-record files by the conv-backend stamp
+    # (pallas / digits / f64 measure different kernels); resolve across all
+    # suffixes plus the pre-stamp legacy name, preferring the largest rung
+    # then the freshest capture — the emitted record is self-describing
+    # either way (it carries conv_impl + jax_version)
+    base = name[: -len(".json")]
+    candidates = [name] + [
+        f"{base}.{impl}.json"
+        for impl in ("pallas", "digits", "f64", "shear", "unstamped",
+                     "unknown")  # _backend_stamp's exception sentinel
+    ]
+    best = []
+    for nm in candidates:
+        try:
+            with open(os.path.join(_CACHE_DIR, nm)) as f:
+                cand = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if cand.get("platform") == "tpu":
+            best.append(cand)
+    if not best:
         return None
-    if rec.get("platform") != "tpu":
-        return None
+    rec = max(
+        best,
+        key=lambda r: (r.get("_rung", -1), r.get("captured_at") or ""),
+    )
     rec.pop("_rung", None)
     head = git_head()
     captured = rec.get("git_head")
@@ -1830,6 +1872,7 @@ def _main_measure(mode: str) -> None:
                 }.get(mode, "sets/s"),
                 "vs_baseline": 0.0,
                 "platform": platform,
+                **_backend_stamp(),
                 "fallback": fallback,
                 "error": last_err or "no shape rung completed",
             }

@@ -147,7 +147,7 @@ def graph_registry(batch: int) -> list[tuple]:
     import jax.numpy as jnp
 
     from ..bls import tpu_backend as tb
-    from ..ops.bls import curve, fq, h2c, pairing, tower
+    from ..ops.bls import curve, fq, h2c, pairing, pallas_kernels as pk, plans, tower
     from ..ops.bls_oracle.fields import BLS_X
 
     u64 = jnp.uint64
@@ -261,6 +261,34 @@ def graph_registry(batch: int) -> list[tuple]:
              jax.ShapeDtypeStruct((), jnp.bool_),            # ok_part
              jax.ShapeDtypeStruct(B, jnp.bool_),             # valid
          )),
+        # ops/bls/pallas_kernels.py — the fused Pallas conv+fold+carry
+        # kernels (ISSUE 13), certified EXPLICITLY and backend-independently:
+        # their digit-domain schedules (conv f32 exactness, fold budgets,
+        # out-lincomb covers, reduce value/limb/top targets) register
+        # pallas_* obligations at trace time regardless of the active conv
+        # backend, so the f64/digits regimes prove them too. Under the
+        # "pallas" regime the whole tower/h2c/pairing surface above ALSO
+        # routes through these kernels — this block pins the kernel
+        # entry points by name even when that regime is restricted.
+        ("pallas.fused_mul",
+         lambda a, b: pk.fused_mul(a, b, lazy=False), (e1, e1)),
+        ("pallas.fused_mul_lazy",
+         lambda a, b: pk.fused_mul(a, b, lazy=True), (e1, e1)),
+        ("pallas.execute_fq12_mul",
+         lambda a, b: pk.execute_plan(
+             plans.MUL12, a, b, plans.PUB_BOUND, plans.PUB_BOUND, "fq12_mul"
+         ), (e12, e12)),
+        # CYC_SQR covers the pass-through rows; the F12 out_bound covers the
+        # lazy chain-interior target; FROB12 covers the constant pool
+        ("pallas.execute_cyc_sqr_lazy",
+         lambda a: pk.execute_plan(
+             plans.CYC_SQR, a, a, plans.F12_BOUND, plans.F12_BOUND,
+             "cyc_sqr_c", plans.F12_BOUND,
+         ), (e12,)),
+        ("pallas.execute_frob12",
+         lambda a: pk.execute_plan(
+             plans.FROB12, a, a, plans.PUB_BOUND, plans.PUB_BOUND, "frob12"
+         ), (e12,)),
         # slasher/kernels.py — the whole-registry surveillance sweep
         # (ISSUE 11): window roll + scatter + directional scans + candidate
         # flags over the span planes. Its obligations (u16 distance width,
@@ -293,8 +321,12 @@ def _slasher_sweep_graph():
 # the lincomb/fold arithmetic), so certify a scalar-ish and a wide regime.
 # NOTE with fq.F64_WALK_MIN_ROWS = 0 both regimes take the all-f64 walk;
 # the u64 walk is covered by the forced-threshold test in test_analysis.py.
+# The "pallas" regime re-executes the whole surface through the fused
+# Pallas kernels (tracing the kernel bodies abstractly — interpret-mode
+# pallas_call supports eval_shape), proving the digit-domain schedules on
+# every graph shape the other backends prove their walks on.
 _DEFAULT_BATCHES = (1, 32)
-_DEFAULT_BACKENDS = ("f64", "digits")
+_DEFAULT_BACKENDS = ("f64", "digits", "pallas")
 
 
 def _trace_graph(sink: CertSink, name: str, fn, specs) -> None:

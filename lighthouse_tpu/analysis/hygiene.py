@@ -136,12 +136,16 @@ class _ModuleScan(ast.NodeVisitor):
     leading params are statically bound, and which functions declare
     static argnums/argnames."""
 
-    # function-position argument index per lax-style combinator
+    # function-position argument index per lax-style combinator.
+    # pallas_call (ISSUE 13): Pallas kernel bodies are traced exactly like
+    # jit scopes — host syncs, tracer branches and impure closures inside a
+    # kernel are the same bugs, and the fused limb kernels would otherwise
+    # be a lint blind spot.
     _BODY_ARGS = {
         "scan": (0,), "associative_scan": (0,), "fori_loop": (2,),
         "while_loop": (0, 1), "vmap": (0,), "pmap": (0,), "shard_map": (0,),
         "checkpoint": (0,), "remat": (0,), "custom_jvp": (0,),
-        "eval_shape": (0,),
+        "eval_shape": (0,), "pallas_call": (0,),
     }
 
     def __init__(self):

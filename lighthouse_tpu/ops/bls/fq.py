@@ -370,18 +370,21 @@ _CONV_IMPL = None
 
 
 def conv_backend() -> str:
-    """Which conv implementation the default backend gets: "digits" on TPU
-    (f32 VPU path), "f64" elsewhere (CPU SIMD FMA). Cached on first use;
-    override via LIGHTHOUSE_CONV_IMPL=digits|f64|shear for testing."""
+    """Which conv implementation the default backend gets: "pallas" on TPU
+    (fused Pallas/Mosaic digit kernels — conv + congruence fold + carry as
+    one MXU kernel, see pallas_kernels.py), "f64" elsewhere (CPU SIMD FMA).
+    Cached on first use; override via
+    LIGHTHOUSE_CONV_IMPL=pallas|digits|f64|shear for testing ("pallas" off
+    TPU runs the kernels in interpret mode — exact, but an emulator)."""
     global _CONV_IMPL
     if _CONV_IMPL is None:
         import os
 
         forced = os.environ.get("LIGHTHOUSE_CONV_IMPL")
-        if forced in ("digits", "f64", "shear"):
+        if forced in ("pallas", "digits", "f64", "shear"):
             _CONV_IMPL = forced
         else:
-            _CONV_IMPL = "digits" if jax.default_backend() == "tpu" else "f64"
+            _CONV_IMPL = "pallas" if jax.default_backend() == "tpu" else "f64"
     return _CONV_IMPL
 
 
@@ -391,7 +394,10 @@ def conv_limb_bounds(in_limb_a: int, in_limb_b: int | None = None) -> list[int]:
     float-exactness of the chosen path."""
     if in_limb_b is None:
         in_limb_b = in_limb_a
-    if conv_backend() == "digits":
+    # "pallas" shares the digit-split accumulator shape: these bounds apply
+    # to its (rarely taken) _conv_product fallback; the fused kernels track
+    # their own digit-domain bounds in pallas_kernels.py
+    if conv_backend() in ("digits", "pallas"):
         da = _digit_bound(in_limb_a)
         db = _digit_bound(in_limb_b)
         # digit conv position d has min(d, 100-d, 50)+1 terms
@@ -434,9 +440,14 @@ def conv_limb_bounds(in_limb_a: int, in_limb_b: int | None = None) -> list[int]:
 def _conv_product(a, b):
     """Convolution product -> 50 u64 accumulators (platform-dispatched; see
     _conv_product_f64 / _conv_product_digits / _conv_product_shear). Inputs
-    must satisfy the lazy budget: limbs < 2^22, value < 1200p."""
+    must satisfy the lazy budget: limbs < 2^22, value < 1200p.
+
+    Under the "pallas" backend the HOT path never calls this — mont_mul /
+    mont_mul_lazy / plans.execute dispatch to the fused pallas kernels
+    (conv + fold + carry in one pallas_call); stray callers of the bare
+    conv seam get the bit-equivalent u64 digit accumulators."""
     impl = conv_backend()
-    if impl == "digits":
+    if impl in ("digits", "pallas"):
         return _conv_product_digits(a, b)
     if impl == "f64":
         return _conv_product_f64_u64(a, b)
@@ -471,7 +482,7 @@ def _conv_product_keep(a, b):
     fold schedule (2^53 exactness cap, statically re-derived) on SIMD FMAs.
     reduce_limbs casts back to u64 at the end."""
     impl = conv_backend()
-    if impl == "digits":
+    if impl in ("digits", "pallas"):
         return _conv_product_digits(a, b)
     if impl == "f64":
         if max(_static_rows(a), _static_rows(b)) >= F64_WALK_MIN_ROWS:
@@ -760,11 +771,16 @@ def mont_mul(a, b):
     _IN_LIMB (2^22); output satisfies plans.PUB_BOUND (<= 13p, 17-bit limbs,
     top <= 2).
 
-    The conv runs in f64 (CPU) / f32 digits (TPU). On the f64 backend the
-    fold walk stays in f64 as well (u64 multiplies scalarize on x86 — see
-    _conv_product_keep); the conv chain's optimization_barrier fences the
-    graph so XLA does not recompute it per consumer (the historical all-f64
-    pathology)."""
+    The conv runs in f64 (CPU) / fused f32 digit kernels (TPU "pallas"
+    backend: conv + congruence fold + carry inside ONE pallas_call — see
+    pallas_kernels.fused_mul). On the f64 backend the fold walk stays in f64
+    as well (u64 multiplies scalarize on x86 — see _conv_product_keep); the
+    conv chain's optimization_barrier fences the graph so XLA does not
+    recompute it per consumer (the historical all-f64 pathology)."""
+    if conv_backend() == "pallas":
+        from . import pallas_kernels
+
+        return pallas_kernels.fused_mul(a, b, lazy=False)
     t = _conv_product_keep(a, b)
     return reduce_limbs(t, conv_limb_bounds(_IN_LIMB), _IN_VALUE * _IN_VALUE)
 
@@ -812,6 +828,10 @@ def mont_mul_lazy(a, b):
     lazier target)."""
     _cert("chain_in_budget_limb", CHAIN_LIMB_TARGET, _IN_LIMB)
     _cert("chain_in_budget_value", CHAIN_VALUE_LIMIT, _IN_VALUE)
+    if conv_backend() == "pallas":
+        from . import pallas_kernels
+
+        return pallas_kernels.fused_mul(a, b, lazy=True)
     t = _conv_product_keep(a, b)
     return reduce_limbs(
         t,

@@ -558,17 +558,18 @@ def miller_product(px, py, qx, qy, valid=None):
     """Unreduced prod_i f_{x,Q_i}(P_i) over the leading batch axis — the
     verify path's Miller stage, dispatched by conv backend at trace time:
 
-    * digits (TPU): the shared-accumulator ``miller_loop_product`` — conv
-      lane counts dominate there, and collapsing the n per-pair accumulator
-      squarings to one plus sparse-first cross-pair line trees is a strict
-      lane win;
+    * digits / pallas (TPU): the shared-accumulator ``miller_loop_product``
+      — conv lane counts dominate there, and collapsing the n per-pair
+      accumulator squarings to one plus sparse-first cross-pair line trees
+      is a strict lane win (the pallas fused kernels inherit the digit
+      backend's lane-count economics);
     * f64 (CPU): independent batched accumulators + a halving product tree —
       measured FASTER below ~dozens of pairs (at the 9-pair verify shape the
       cross-pair trees' dense fq12 multiplies at shrinking batch widths cost
       more than the n-1 extra squarings they avoid, which SIMD over the
       batch axis makes nearly free).
     """
-    if fq.conv_backend() == "digits":
+    if fq.conv_backend() in ("digits", "pallas"):
         return miller_loop_product(px, py, qx, qy, valid)
     fs = miller_loop(px, py, qx, qy)
     if valid is not None:

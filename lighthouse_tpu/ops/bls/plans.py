@@ -312,8 +312,12 @@ def f12_interior():
     the way out. On the f64 backend the accumulator bound grows with the
     input limbs (25 * limb^2): F12_BOUND's extra input bit costs MORE fold
     rounds than its looser target saves (measured ~15% slower per fq12 op),
-    so interiors stay at PUB_BOUND and the walk kwarg stays default."""
-    if fq.conv_backend() == "digits":
+    so interiors stay at PUB_BOUND and the walk kwarg stays default.
+
+    The "pallas" backend shares the digit-split property (its in-kernel conv
+    accumulator bound comes from the base-2^8 digit split, not the input
+    limb width), so it takes the digits arm."""
+    if fq.conv_backend() in ("digits", "pallas"):
         return F12_BOUND, F12_BOUND
     return PUB_BOUND, None
 
@@ -404,6 +408,28 @@ def lincomb(rows: list[LC], x, in_bound: _Bound, name: str = "", bound_for=None)
     consts, worst = _lincomb_bounds(rows, bound_for, name)
     m_pos, m_neg = _lincomb_matrices(rows, x.shape[-2])
     return _apply_matrices(m_pos, m_neg, consts, x), worst
+
+
+def append_const_pool(plan: Plan, b):
+    """Concatenate the plan's constant pool onto the B operand — the pool
+    append ORDER defines what plan.b_rows indices >= n_b mean, so both
+    executors (the XLA path below and pallas_kernels.execute_plan) must go
+    through this one helper."""
+    if not plan.consts:
+        return b
+    cpool = jnp.asarray(np.stack([fq.int_to_limbs(c) for c in plan.consts]))
+    cpool = jnp.broadcast_to(cpool, b.shape[:-2] + cpool.shape)
+    return jnp.concatenate([b, cpool.astype(b.dtype)], axis=-2)
+
+
+def remap_passthrough_rows(plan: Plan, n_lanes: int) -> list[LC]:
+    """Out rows with Plan.inp() pass-through references remapped onto the
+    [lanes | a] concatenated basis (negative index -(i+1) -> n_lanes + i).
+    The addressing convention is shared by both executors — one definition."""
+    return [
+        LC({(i if i >= 0 else n_lanes - 1 - i): c for i, c in lc.d.items()})
+        for lc in plan.out_rows
+    ]
 
 
 # Raw (non-domain) limbs of 2^384 mod p: folds limb-24 excess back below 2^384.
@@ -510,18 +536,23 @@ def execute(
     convolution, out-lincomb, reduction walk — runs in f64 and only the
     final reduced limbs are cast back to u64: u64 multiplies have no x86
     SIMD path and dominated the execute cost. Exactness: every intermediate
-    bound is asserted below the 2^53 f64 integer cap."""
+    bound is asserted below the 2^53 f64 integer cap.
+
+    On the "pallas" backend the pipeline after the input lincombs — conv,
+    out-lincomb, fold, carry — runs as ONE fused Pallas kernel
+    (pallas_kernels.execute_plan); bounds are tracked in digit space there."""
+    if fq.conv_backend() == "pallas":
+        from . import pallas_kernels
+
+        return pallas_kernels.execute_plan(
+            plan, a, b, in_bound_a, in_bound_b, name, out_bound
+        )
     lane_rows = fq._static_rows(a[..., 0, :]) * len(plan.a_rows)
     if fq.conv_backend() == "f64" and lane_rows >= fq.F64_WALK_MIN_ROWS:
         a = a.astype(jnp.float64)
         b = b.astype(jnp.float64)
     A, ba = lincomb(plan.a_rows, a, in_bound_a, name + ".A")
-    if plan.consts:
-        cpool = jnp.asarray(
-            np.stack([fq.int_to_limbs(c) for c in plan.consts])
-        )
-        cpool = jnp.broadcast_to(cpool, b.shape[:-2] + cpool.shape)
-        b = jnp.concatenate([b, cpool.astype(b.dtype)], axis=-2)
+    b = append_const_pool(plan, b)
     B, bb = lincomb(plan.b_rows, b, in_bound_b, name + ".B")
     T = fq._conv_product_keep(A, B)  # [..., L, 50] unreduced accumulators
     conv_limb = max(fq.conv_limb_bounds(ba.limb, bb.limb))
@@ -552,10 +583,7 @@ def execute(
         # pass-through rows reference `a`: zero-pad it into the wide space
         pad = [(0, 0)] * (a.ndim - 1) + [(0, n_wide - a.shape[-1])]
         T = jnp.concatenate([T, jnp.pad(a, pad).astype(T.dtype)], axis=-2)
-        out_rows = [
-            LC({(i if i >= 0 else L - 1 - i): c for i, c in lc.d.items()})
-            for lc in plan.out_rows
-        ]
+        out_rows = remap_passthrough_rows(plan, L)
     else:
         out_rows = plan.out_rows
     worst_limb = 0

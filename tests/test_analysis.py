@@ -54,7 +54,7 @@ class TestCertifier:
         bad = [r for r in cert["obligations"] if not r["ok"]]
         assert cert["ok"] and not bad, bad[:5]
         graphs = {r["graph"] for r in cert["obligations"]}
-        for mod in ("fq.", "tower.", "curve.", "h2c.", "pairing."):
+        for mod in ("fq.", "tower.", "curve.", "h2c.", "pairing.", "pallas."):
             assert any(mod in g for g in graphs), f"no obligations from {mod}*"
         for backend in ("f64@", "digits@"):
             assert any(g.startswith(backend) for g in graphs)
@@ -69,6 +69,14 @@ class TestCertifier:
             "reduce_limb",
             "out_bound_top_sound",     # (c) declared CHAIN/out_bound sound
             "lincomb_limb_budget",
+            # the fused Pallas kernels (ISSUE 13) register their digit-
+            # domain schedule obligations through the same sink — proven
+            # here under the f64/digits regimes via the explicit pallas.*
+            # registry graphs (the kernels are backend-independent entries)
+            "pallas_conv_digit_f32_exact",
+            "pallas_fold_f32_exact",
+            "pallas_reduce_value",
+            "pallas_reduce_limb",
         } <= kinds
 
     def test_u64_walk_regime_certifies(self, monkeypatch):
@@ -85,6 +93,23 @@ class TestCertifier:
             graphs=["fq.mont_mul", "fq.canonical", "tower.fq2_mul"],
         )
         assert cert["ok"] and cert["n_failed"] == 0
+
+    def test_pallas_regime_certifies(self):
+        """The third backend regime: the representative graph subset
+        re-executes THROUGH the fused pallas kernels (plans.execute and
+        mont_mul dispatch there under LIGHTHOUSE_CONV_IMPL=pallas) and
+        stays green — the full pallas sweep is the analysis CLI's (and the
+        hunter preflight's) job."""
+        cert = bounds.certify(
+            backends=("pallas",),
+            batches=(1, 32),
+            graphs=["fq.mont", "tower.fq12_mul", "tower.fq2_sqrt",
+                    "curve.point_dbl", "pallas."],
+        )
+        assert cert["ok"] and cert["n_failed"] == 0
+        kinds = {r["kind"] for r in cert["obligations"]}
+        assert "pallas_conv_digit_f32_exact" in kinds
+        assert "pallas_out_bound_top_sound" in kinds
 
     def test_seeded_mutation_widened_interior_fails(self, monkeypatch):
         """Widening one lazy interior by one squaring (declared CHAIN bound
@@ -216,6 +241,18 @@ _BAD_MODULE = textwrap.dedent(
     @jax.jit
     def pragma_ok(x):
         return int(x[0])              # lint: allow(host-sync)
+
+    from jax.experimental import pallas as pl
+
+    def pallas_user(x):
+        def kern(x_ref, o_ref):       # fixture: pallas kernel body is a
+            v = x_ref[...]            # jit scope (ISSUE 13)
+            LOG.append(v)             # fixture: impure closure in kernel
+            if v[0] > 0:              # fixture: tracer branch in kernel
+                o_ref[...] = v
+            o_ref[...] = v * 2
+
+        return pl.pallas_call(kern, out_shape=x)(x)
     '''
 )
 
@@ -239,6 +276,10 @@ class TestHygieneLinter:
         assert "tracer_branch" in flagged_fns
         assert "impure" in flagged_fns
         assert "body" in flagged_fns          # lax.scan body covered
+        # pallas_call kernel bodies are jit scopes (ISSUE 13): both the
+        # impure closure mutation and the tracer branch inside `kern` fire
+        kern_rules = {f.rule for f in findings if "kern" in f.message}
+        assert {"impure-closure", "tracer-branch"} <= kern_rules
         # negative space: statics and shape reads are not findings
         assert "static_ok" not in flagged_fns
         assert "shape_ok" not in flagged_fns

@@ -22,15 +22,18 @@ rng = random.Random(0xF1E1D)
 
 
 @pytest.fixture(
-    autouse=True, params=["f64", "digits"], ids=["conv-f64", "conv-digits"]
+    autouse=True,
+    params=["f64", "digits", "pallas"],
+    ids=["conv-f64", "conv-digits", "conv-pallas"],
 )
 def conv_impl(request, monkeypatch):
-    """Run every fq/plans kernel-parity test under BOTH convolution
-    backends: the CPU default (f64 FMA chain) AND the TPU default (f32
-    digit split) — the consensus-critical TPU path must be validated on
-    every CPU CI run, not only when a TPU window opens (ADVICE r5).
-    conv_backend() is consulted at trace time and each test constructs
-    fresh jit wrappers, so resetting the cached choice is sufficient."""
+    """Run every fq/plans kernel-parity test under ALL convolution
+    backends: the CPU default (f64 FMA chain), the XLA digit split, AND
+    the fused Pallas kernels (the TPU default, interpret mode here) — the
+    consensus-critical TPU path must be validated on every CPU CI run,
+    not only when a TPU window opens (ADVICE r5). conv_backend() is
+    consulted at trace time and each test constructs fresh jit wrappers,
+    so resetting the cached choice is sufficient."""
     monkeypatch.setenv("LIGHTHOUSE_CONV_IMPL", request.param)
     old = fq._CONV_IMPL
     fq._CONV_IMPL = None

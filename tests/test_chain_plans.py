@@ -43,7 +43,9 @@ FIXED_SCALARS = [
 
 
 @pytest.fixture(
-    autouse=True, params=["f64", "digits"], ids=["conv-f64", "conv-digits"]
+    autouse=True,
+    params=["f64", "digits", "pallas"],
+    ids=["conv-f64", "conv-digits", "conv-pallas"],
 )
 def conv_impl(request, monkeypatch):
     monkeypatch.setenv("LIGHTHOUSE_CONV_IMPL", request.param)

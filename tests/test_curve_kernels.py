@@ -18,6 +18,21 @@ from lighthouse_tpu.ops.bls_oracle.fields import P, Fq2, fq_sqrt
 pytestmark = pytest.mark.slow  # nightly tier: exhaustive kernel parity
 
 
+@pytest.fixture(
+    autouse=True,
+    params=["f64", "pallas"],
+    ids=["conv-f64", "conv-pallas"],
+)
+def conv_impl(request, monkeypatch):
+    """Exhaustive curve-kernel parity under the CPU default AND the fused
+    Pallas kernels (interpret mode — ISSUE 13)."""
+    monkeypatch.setenv("LIGHTHOUSE_CONV_IMPL", request.param)
+    old = fq._CONV_IMPL
+    fq._CONV_IMPL = None
+    yield request.param
+    fq._CONV_IMPL = old
+
+
 RNG = np.random.default_rng(42)
 
 

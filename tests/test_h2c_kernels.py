@@ -6,11 +6,27 @@ import jax
 import numpy as np
 
 import lighthouse_tpu  # noqa: F401
-from lighthouse_tpu.ops.bls import g2 as dg2, h2c
+from lighthouse_tpu.ops.bls import fq, g2 as dg2, h2c
 from lighthouse_tpu.ops.bls_oracle import hash_to_curve as oh
 from lighthouse_tpu.ops.bls_oracle.ciphersuite import DST
 
 pytestmark = pytest.mark.slow  # nightly tier: exhaustive kernel parity
+
+
+@pytest.fixture(
+    autouse=True,
+    params=["f64", "pallas"],
+    ids=["conv-f64", "conv-pallas"],
+)
+def conv_impl(request, monkeypatch):
+    """Exhaustive h2c parity under the CPU default AND the fused Pallas
+    kernels (interpret mode — ISSUE 13; the digits backend's h2c parity is
+    covered by the bounds certificate + the tier-1 pallas suite)."""
+    monkeypatch.setenv("LIGHTHOUSE_CONV_IMPL", request.param)
+    old = fq._CONV_IMPL
+    fq._CONV_IMPL = None
+    yield request.param
+    fq._CONV_IMPL = old
 
 
 

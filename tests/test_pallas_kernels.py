@@ -1,0 +1,308 @@
+"""Fused Pallas limb-kernel backend (``LIGHTHOUSE_CONV_IMPL=pallas``).
+
+Interpret-mode parity of the fused conv -> congruence-fold -> carry kernels
+(ops/bls/pallas_kernels.py) against the oracle AND the digits backend
+(canonical values must agree exactly — "bit-identical" at every
+serialization/comparison boundary), plus the kernel schedules' bound
+certification and their seeded-mutation coverage. Tier-1 runs the small
+shapes; the heavy composites (full map_to_g2, a reduced pairing) ride the
+slow tier per the wall-clock budget.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lighthouse_tpu  # noqa: F401  (enables x64)
+from lighthouse_tpu.analysis import bounds
+from lighthouse_tpu.ops.bls import fq, pallas_kernels as pk, plans, tower as tw
+from lighthouse_tpu.ops.bls_oracle import fields as of
+
+pytestmark = pytest.mark.kernel
+
+rng = random.Random(0x9A77A5)
+
+
+@pytest.fixture(autouse=True)
+def pallas_backend(monkeypatch):
+    """Force the pallas conv backend (interpret mode on this CPU box).
+    conv_backend() is consulted at trace time and every test constructs
+    fresh jit wrappers, so resetting the cached choice is sufficient."""
+    monkeypatch.setenv("LIGHTHOUSE_CONV_IMPL", "pallas")
+    old = fq._CONV_IMPL
+    fq._CONV_IMPL = "pallas"
+    yield
+    fq._CONV_IMPL = old
+
+
+def _with_backend(impl: str, fn):
+    """Run fn under a different conv backend (fresh traces inside)."""
+    old = fq._CONV_IMPL
+    fq._CONV_IMPL = impl
+    try:
+        return fn()
+    finally:
+        fq._CONV_IMPL = old
+
+
+def rint():
+    return rng.randrange(of.P)
+
+
+def rfq2():
+    return of.Fq2(rint(), rint())
+
+
+def rfq12():
+    return of.Fq12(
+        of.Fq6(rfq2(), rfq2(), rfq2()), of.Fq6(rfq2(), rfq2(), rfq2())
+    )
+
+
+def _e(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.uint64)
+
+
+class TestFusedMul:
+    def test_random_and_edge_parity(self):
+        xs = [rint() for _ in range(6)] + [0, 1, of.P - 1]
+        ys = [rint() for _ in range(6)] + [1, of.P - 1, of.P - 1]
+        ax, ay = fq.from_ints(xs), fq.from_ints(ys)
+        out = jax.jit(fq.mont_mul)(ax, ay)
+        assert fq.to_ints(out) == [x * y % of.P for x, y in zip(xs, ys)]
+
+    def test_lazy_budget_inputs(self):
+        """The fused kernel accepts the FULL lazy conv budget (limbs < 2^22,
+        value < 1200p), not just public-bounded operands — the same
+        construction as fq.canonical's budget regression."""
+        nprng = np.random.default_rng(7)
+        raw = nprng.integers(0, 1 << 22, size=(16, 25), dtype=np.uint64)
+        raw[:, 23] &= 0xFFFF
+        raw[:, 24] &= 0x3F
+        vals = [fq.limbs_to_int(raw[i]) for i in range(raw.shape[0])]
+        assert all(v < 1200 * of.P for v in vals)
+        a = jnp.asarray(raw)
+        out = jax.jit(fq.mont_mul)(a, a)
+        got = [fq.to_int(np.asarray(out)[i]) for i in range(16)]
+        assert got == [v * v % of.P for v in vals]
+
+    def test_lazy_chain_fixed_point(self):
+        """mont_mul_lazy outputs re-enter mont_mul_lazy (the chain fixed
+        point) and a scanned fixed-exponent chain stays exact end-to-end."""
+        xs = [rint() for _ in range(4)]
+        ax = fq.from_ints(xs)
+        chained = jax.jit(
+            lambda a: fq.normalize(
+                fq.mont_mul_lazy(fq.mont_mul_lazy(a, a), a)
+            )
+        )(ax)
+        assert fq.to_ints(chained) == [pow(x, 3, of.P) for x in xs]
+        # pow_fixed_scan runs the lazy kernel inside a lax.scan body
+        out = jax.jit(fq.inv)(ax)
+        assert fq.to_ints(out) == [pow(x, of.P - 2, of.P) for x in xs]
+
+    def test_scalar_batch_shapes(self):
+        """Unbatched [25] operands and broadcasting work (chain_plans feeds
+        [1, ..., 1, 25] shapes through the seam)."""
+        x, y = rint(), rint()
+        out = jax.jit(fq.mont_mul)(fq.from_int(x), fq.from_int(y))
+        assert out.shape == (25,)
+        assert fq.to_int(out) == x * y % of.P
+
+    def test_conv_product_fallback_matches_digits(self):
+        """Stray callers of the bare conv seam under the pallas backend get
+        the digit accumulators BIT-identical to the digits backend."""
+        a = fq.from_ints([rint(), rint()])
+        b = fq.from_ints([rint(), rint()])
+        got = np.asarray(jax.jit(fq._conv_product)(a, b))
+        want = _with_backend(
+            "digits", lambda: np.asarray(jax.jit(fq._conv_product)(a, b))
+        )
+        assert (got == want).all()
+
+
+class TestExecutePlans:
+    def test_cross_backend_canonical_parity(self):
+        """The acceptance bar: pallas results canonicalize to EXACTLY the
+        digits backend's values (and the oracle's) across the plan shapes —
+        dense mul, squaring, pass-through rows (cyclotomic), constant pool
+        (Frobenius), lazy F12 interiors."""
+        a, b = rfq12(), rfq12()
+        da, db = tw.fq12_from_oracle(a), tw.fq12_from_oracle(b)
+        g = a.conjugate() * a.inv()
+        g = g.frobenius(2) * g  # cyclotomic subgroup member
+        dg = tw.fq12_from_oracle(g)
+
+        cases = {
+            "mul": (lambda: jax.jit(tw.fq12_mul)(da, db), a * b),
+            "sqr": (lambda: jax.jit(tw.fq12_sqr)(da), a.square()),
+            "frob": (
+                lambda: jax.jit(tw.fq12_frobenius1)(da), a.frobenius(1),
+            ),
+            "cyc_sqr": (
+                lambda: jax.jit(tw.fq12_cyclotomic_sqr)(dg),
+                g.cyclotomic_square(),
+            ),
+            "mul_lazy": (
+                lambda: jax.jit(
+                    lambda x, y: tw.fq12_mul(tw.fq12_mul_lazy(x, y), x)
+                )(da, db),
+                (a * b) * a,
+            ),
+        }
+        for name, (run, want) in cases.items():
+            got = tw.fq12_to_oracle(run())
+            assert got == want, f"pallas {name} diverged from oracle"
+            dig = _with_backend(
+                "digits", lambda run=run: tw.fq12_to_oracle(run())
+            )
+            assert got == dig, f"pallas {name} diverged from digits backend"
+
+    def test_g2_point_ops(self):
+        """Curve layer rides the seam: complete-formula add/dbl on G2 at a
+        small batch."""
+        from lighthouse_tpu.ops.bls import curve, g2
+        from lighthouse_tpu.ops.bls_oracle import curves as OC
+
+        nprng = np.random.default_rng(3)
+        ps = [
+            OC.g2_mul(OC.g2_generator(), int(nprng.integers(1, 2**63)))
+            for _ in range(2)
+        ]
+        qs = [
+            OC.g2_mul(OC.g2_generator(), int(nprng.integers(1, 2**63)))
+            for _ in range(2)
+        ]
+        P_, Q_ = g2.from_oracle_batch(ps), g2.from_oracle_batch(qs)
+        S = jax.jit(lambda x, y: curve.point_add(2, x, y))(P_, Q_)
+        D = jax.jit(lambda x: curve.point_dbl(2, x))(P_)
+        for i in range(2):
+            assert g2.to_oracle(S[i]) == OC.g2_add(ps[i], qs[i])
+            assert g2.to_oracle(D[i]) == OC.g2_add(ps[i], ps[i])
+
+
+class TestSchedulesCertify:
+    def test_fused_graphs_prove_clean(self):
+        """The kernel entry points certify with zero failed obligations and
+        the pallas_* obligation kinds are all present."""
+        sink_rows = []
+        for fn, specs in (
+            (lambda a, b: pk.fused_mul(a, b, lazy=False),
+             (_e((4, 25)), _e((4, 25)))),
+            (lambda a, b: pk.fused_mul(a, b, lazy=True),
+             (_e((4, 25)), _e((4, 25)))),
+            (lambda a, b: pk.execute_plan(
+                plans.MUL12, a, b, plans.PUB_BOUND, plans.PUB_BOUND, "m12"
+            ), (_e((2, 12, 25)), _e((2, 12, 25)))),
+            (lambda a: pk.execute_plan(
+                plans.CYC_SQR, a, a, plans.F12_BOUND, plans.F12_BOUND,
+                "cyc", plans.F12_BOUND,
+            ), (_e((2, 12, 25)),)),
+        ):
+            rows = bounds.certify_callable(fn, specs, backend="pallas")
+            assert rows and all(r["ok"] for r in rows), [
+                r for r in rows if not r["ok"]
+            ][:3]
+            sink_rows.extend(rows)
+        kinds = {r["kind"] for r in sink_rows}
+        assert {
+            "pallas_conv_digit_f32_exact",   # conv products exact in f32
+            "pallas_fold_f32_exact",         # fold matmul accumulators exact
+            "pallas_lincomb_f32_exact",      # fused out-rows exact
+            "pallas_reduce_value",           # walk lands on the value target
+            "pallas_reduce_limb",            # ... and the limb target
+            "pallas_reduce_top_limb",        # PUB top-limb refinement
+            "pallas_out_bound_top_sound",    # declared out_bound soundness
+            "pallas_digit_u32_nowrap",       # recombination cast lossless
+            "pallas_out_width",              # output fits the 50-digit layout
+        } <= kinds, kinds
+
+    def test_seeded_mutation_unsound_out_bound_fails(self):
+        """Declaring an out_bound whose top-limb claim the walk cannot
+        guarantee must turn the certificate red (the pallas twin of the
+        widened-interior mutations)."""
+        bad = plans._Bound(plans.F12_BOUND.value_p, plans.F12_BOUND.limb, 0)
+        rows = bounds.certify_callable(
+            lambda a, b: pk.execute_plan(
+                plans.MUL12, a, b, plans.F12_BOUND, plans.F12_BOUND,
+                "mut", bad,
+            ),
+            (_e((2, 12, 25)), _e((2, 12, 25))),
+            backend="pallas",
+        )
+        assert any(
+            not r["ok"]
+            and r["kind"] in ("pallas_out_bound_top_sound", "unproven_bound")
+            for r in rows
+        )
+
+    def test_seeded_mutation_wider_chain_limb_fails(self, monkeypatch):
+        """A wider chain limb target must break the digit-split f32
+        exactness in the fused kernel too, not only in the XLA digits
+        backend."""
+        monkeypatch.setattr(fq, "CHAIN_LIMB_TARGET", (1 << 27) - 1)
+        monkeypatch.setattr(fq, "CHAIN_VALUE_LIMIT", (1 << 27) * of.P)
+        rows = bounds.certify_callable(
+            lambda a, b: pk.fused_mul(a, b, lazy=True),
+            (_e((2, 25)), _e((2, 25))),
+            backend="pallas",
+        )
+        assert any(
+            not r["ok"]
+            and r["kind"]
+            in ("pallas_conv_digit_f32_exact", "unproven_bound")
+            for r in rows
+        )
+
+    def test_zero_steady_state_recompiles(self):
+        """The fused kernels behave like any other jitted program under the
+        recompile sentinel: a warm loop stays at zero compiles (the ISSUE
+        13 acceptance keeps the sentinel at zero on the pallas path)."""
+        from lighthouse_tpu.analysis.recompile import steady_state_compiles
+
+        a = fq.from_ints([rint() for _ in range(4)])
+        mul = jax.jit(fq.mont_mul)
+
+        def step():
+            jax.block_until_ready(mul(a, a))
+
+        assert steady_state_compiles(step, warmup=2, steps=3) == []
+
+
+@pytest.mark.slow
+class TestHeavyComposites:
+    """Full-pipeline pallas parity (nightly tier: interpret-mode compiles of
+    the composed kernels run minutes on this box)."""
+
+    def test_full_map_to_g2(self):
+        from lighthouse_tpu.ops.bls import g2 as dg2, h2c
+        from lighthouse_tpu.ops.bls_oracle import hash_to_curve as oh
+        from lighthouse_tpu.ops.bls_oracle.ciphersuite import DST
+
+        msgs = [b"abc", b"pallas"]
+        pts = jax.jit(h2c.map_to_g2)(*h2c.hash_to_field_batch(msgs, DST))
+        for i, m in enumerate(msgs):
+            assert dg2.to_oracle(pts[i]) == oh.hash_to_curve_g2(m, DST), i
+
+    def test_pairing_bilinearity(self):
+        import importlib
+
+        from lighthouse_tpu.ops.bls import pairing
+        from lighthouse_tpu.ops.bls_oracle import curves as oc
+
+        op = importlib.import_module("lighthouse_tpu.ops.bls_oracle.pairing")
+        g1p = oc.g1_mul(oc.g1_generator(), 5)
+        g2p = oc.g2_mul(oc.g2_generator(), 3)
+        px = fq.from_int(g1p[0])[None]
+        py = fq.from_int(g1p[1])[None]
+        qx = tw.from_ints([g2p[0].c0, g2p[0].c1])[None]
+        qy = tw.from_ints([g2p[1].c0, g2p[1].c1])[None]
+        f = jax.jit(pairing.miller_loop)(px, py, qx, qy)
+        out = jax.jit(pairing.final_exponentiation)(f)
+        assert tw.fq12_to_oracle(out[0]) == op.final_exponentiation(
+            op.miller_loop(g1p, g2p)
+        )

@@ -148,6 +148,8 @@ def main():
     if emit_json:
         import subprocess
 
+        from lighthouse_tpu.ops.bls import fq
+
         try:
             head = (
                 subprocess.run(
@@ -159,6 +161,9 @@ def main():
             head = "unknown"
         print(json.dumps(
             {"shape": {"sets": n, "keys": k}, "git_head": head,
+             # conv-backend stamp (ISSUE 13): pallas vs digits vs f64 lower
+             # to different programs — probe records must say which
+             "conv_impl": fq.conv_backend(),
              "stages": _RESULTS}
         ))
 

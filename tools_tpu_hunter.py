@@ -23,6 +23,13 @@ Run detached:  nohup python tools_tpu_hunter.py > hunter.log 2>&1 &
 State in .bench_cache/hunter_state.json lets a restart resume at the next
 unconquered rung.
 
+ISSUE 13: on a TPU platform the ladder's inner processes now resolve
+LIGHTHOUSE_CONV_IMPL to "pallas" by default (fq.conv_backend) — every rung
+of the next healthy window attempts Milestone 1 (vs_baseline >= 1) and the
+first `platform: tpu` record on the fused Pallas limb kernels. Records are
+stamped with conv_impl + jax_version and best-record files are keyed by the
+stamp, so pallas/digits/f64 captures never overwrite each other.
+
 Reference property chased: blst's warm-up-free batch verify,
 /root/reference/crypto/bls/src/impls/blst.rs:37-119; target BASELINE.json.
 """
@@ -65,7 +72,11 @@ RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
 PROBE_TIMEOUT_S = float(os.environ.get("HUNTER_PROBE_TIMEOUT", "120"))
-PREFLIGHT_TIMEOUT_S = float(os.environ.get("HUNTER_PREFLIGHT_TIMEOUT", "600"))
+# 900s: the five-pass preflight now certifies THREE conv backends (the
+# pallas regime re-traces the whole graph surface through the fused
+# kernels — bounds alone is ~4.5 min on this box); memoized per HEAD, so
+# the cost is paid once per commit, never per window
+PREFLIGHT_TIMEOUT_S = float(os.environ.get("HUNTER_PREFLIGHT_TIMEOUT", "900"))
 
 # bench._LADDER reversed: smallest first — land ANY TPU record, then climb.
 # Timeouts get +50% slack over bench's (a window may open mid-compile).
@@ -223,8 +234,12 @@ def run_rung(rung_idx: int) -> tuple[dict | None, str | None]:
     Returns (record | None, classified fault kind | None) — the kind drives
     the window scheduler: a ``hang`` skips to the next rung."""
     sets, keys, validators, batch, timeout, mode = RUNGS[rung_idx]
+    # the inner process resolves the conv backend itself (TPU default is now
+    # the fused pallas kernels — Milestone 1's target path); log the forced
+    # override if one is set so window logs attribute the attempt
     log("bench_start", rung=rung_idx, sets=sets, keys=keys, batch=batch,
-        mode=mode)
+        mode=mode,
+        conv_impl=os.environ.get("LIGHTHOUSE_CONV_IMPL", "platform-default"))
     t0 = time.perf_counter()
     rec, note = bench.run_inner(
         sets, keys, validators, batch, timeout, fallback=False, mode=mode
@@ -265,6 +280,13 @@ def persist(rec: dict, rung_idx: int) -> None:
         ("slashable_checks_per_s", False): RECORD_SLASHER,
         ("slashable_checks_per_s", True): RECORD_SLASHER_SHARDED,
     }.get((rec.get("metric"), sharded), RECORD)
+    # ISSUE 13: best-record files are ALSO keyed by the record's conv-backend
+    # stamp — a pallas record and a digits/f64 record measure different
+    # kernels and must never overwrite each other silently. Pre-stamp legacy
+    # files keep their unsuffixed names and are left untouched;
+    # bench._hunter_record resolves across all suffixes.
+    impl = rec.get("conv_impl") or "unstamped"
+    record_path = record_path[: -len(".json")] + f".{impl}.json"
     best = None
     try:
         with open(record_path) as f:
