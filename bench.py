@@ -1530,6 +1530,124 @@ def _inner_slasher():
     )
 
 
+def _inner_kzg_cells():
+    """PeerDAS cell-proof rung (ISSUE 16): device-batched KZG cell
+    verification — every cell of a mainnet-count blob block folded into ONE
+    combined pairing check (2 pairs, one Miller product + one final exp).
+    Reports ``kzg_cells_verified_per_s`` for the compiled engine batch at
+    the test-scale domain, with the per-cell host loop (the exact
+    ``CellContext`` oracle the dispatch seam falls back to) timed at the
+    same workload as the twin baseline. The engine's ``compile_probe``
+    record is embedded so the one-pairing-per-batch invariant is pinned in
+    the measurement itself; verdict honesty is asserted in-rung (honest
+    batch True, tampered proof False) before any timing lands."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    import jax
+
+    if fallback:
+        jax.config.update("jax_platforms", "cpu")
+
+    from lighthouse_tpu import bls
+    from lighthouse_tpu.kzg import engine
+    from lighthouse_tpu.kzg.cells import CellContext
+    from lighthouse_tpu.kzg.fr import bls_field_to_bytes
+    from lighthouse_tpu.kzg.kzg import Kzg
+    from lighthouse_tpu.kzg.setup import insecure_setup
+
+    bls.set_backend("native")
+    n = int(os.environ.get("BENCH_KZG_N", "64"))
+    cells_per = int(os.environ.get("BENCH_KZG_CELLS", "16"))
+    blobs_n = BATCH or 6  # mainnet Deneb max blobs per block
+    iters = int(os.environ.get("BENCH_KZG_ITERS", "5"))
+    k = 2 * n // cells_per
+    platform = jax.devices()[0].platform
+    ctx = CellContext(
+        Kzg(insecure_setup(n, n_g2=k + 1)), cells_per_ext_blob=cells_per
+    )
+
+    rng = np.random.default_rng(0xDA5)
+    commitments, cell_idx, cells, proofs = [], [], [], []
+    t0 = time.perf_counter()
+    for _ in range(blobs_n):
+        blob = b"".join(
+            bls_field_to_bytes(int(rng.integers(1, 2**62))) for _ in range(n)
+        )
+        comm = ctx.kzg.blob_to_kzg_commitment(blob)
+        cs, ps = ctx.compute_cells_and_kzg_proofs(blob)
+        commitments += [comm] * cells_per
+        cell_idx += list(range(cells_per))
+        cells += cs
+        proofs += ps
+    batch = len(cells)
+    print(
+        f"# fixture: {blobs_n} blobs -> {batch} cells "
+        f"({time.perf_counter() - t0:.0f}s)",
+        flush=True,
+    )
+
+    eng = engine.get_engine(ctx)
+    probe = eng.compile_probe(batch)
+    t0 = time.perf_counter()
+    ok = eng.verify_batch(commitments, cell_idx, cells, proofs)
+    print(
+        f"# warmup (compile) {time.perf_counter() - t0:.0f}s on {platform}",
+        flush=True,
+    )
+    assert ok, "honest cell batch rejected — engine broken, no record"
+    tampered = list(proofs)
+    tampered[1], tampered[cells_per] = tampered[cells_per], tampered[1]
+    assert not eng.verify_batch(commitments, cell_idx, cells, tampered), (
+        "tampered cell batch accepted — engine broken, no record"
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ok = eng.verify_batch(commitments, cell_idx, cells, proofs)
+    dt = time.perf_counter() - t0
+    value = batch * iters / dt if dt else 0.0
+
+    # host twin: the per-cell oracle loop at the SAME workload (one pairing
+    # check per cell — the cost the batched engine amortizes away)
+    t0 = time.perf_counter()
+    host_ok = all(
+        ctx.verify_cell_kzg_proof(c, i, ce, p)
+        for c, i, ce, p in zip(commitments, cell_idx, cells, proofs)
+    )
+    host_dt = time.perf_counter() - t0
+    assert host_ok, "host oracle rejected the honest batch"
+    host_value = batch / host_dt if host_dt else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "kzg_cells_verified_per_s",
+                "value": round(value, 2),
+                "unit": "cells/s",
+                "vs_baseline": (
+                    round(value / host_value, 3) if host_value else None
+                ),
+                "platform": platform,
+                **_backend_stamp(),
+                "kzg_backend": engine.get_kzg_backend(),
+                "fallback": fallback,
+                "shape": {
+                    "blobs": blobs_n,
+                    "cells_per_blob": cells_per,
+                    "batch_cells": batch,
+                    "field_elements_per_blob": n,
+                },
+                "ms_per_batch": round(dt / iters * 1e3, 3) if iters else None,
+                "host_loop_cells_per_s": round(host_value, 2),
+                # the tentpole invariant, pinned inside the record: the whole
+                # batch settles in ONE combined pairing check of 2 pairs
+                "compile_probe": probe,
+                "resilience": _resilience_summary(),
+            }
+        )
+    )
+
+
 # Shape ladder: (sets, keys, validators, batch, timeout_s). The first entry
 # is the mainnet shape (BASELINE.json config #4); smaller rungs bound a
 # pathological device compile (observed: the tunnel's server-side compile of
@@ -1599,6 +1717,12 @@ _H2C_RUNG_SMALL = (0, 0, 0, 8, 1350.0, "h2c")
 # compile-warm in .jax_cache, so a short TPU window measures instead of
 # compiling.
 _PAIRING_RUNG_SMALL = (0, 0, 0, 8, 1350.0, "pairing")
+
+# PeerDAS cell-proof rung (ISSUE 16): `batch` is the blob count per block
+# (mainnet Deneb max 6 -> 96 cells at the test-scale domain); the domain
+# geometry comes from BENCH_KZG_* env. The 2700 s timeout bounds the
+# engine's batch-graph compile on a CPU proxy; warm .jax_cache measures.
+_KZG_CELLS_RUNG_SMALL = (0, 0, 0, 6, 2700.0, "kzg_cells")
 
 
 def git_head() -> str:
@@ -1723,6 +1847,8 @@ def main():
         mode = "h2c"
     elif "--pairing" in sys.argv:
         mode = "pairing"
+    elif "--kzg-cells" in sys.argv:
+        mode = "kzg_cells"
     if "--inner" in sys.argv:
         inner_mode = os.environ.get("BENCH_MODE", mode)
         if inner_mode == "firehose":
@@ -1737,6 +1863,8 @@ def main():
             _inner_h2c()
         elif inner_mode == "pairing":
             _inner_pairing()
+        elif inner_mode == "kzg_cells":
+            _inner_kzg_cells()
         else:
             _inner()
         return
@@ -1810,6 +1938,10 @@ def _main_measure(mode: str) -> None:
         ladder = [(0, 0, 0, BATCH, 900.0)]
         if fallback:
             ladder = [(0, 0, 0, 8, 900.0)]
+    elif mode == "kzg_cells":
+        # batch = blobs per block; the fallback rung keeps the mainnet blob
+        # count (the graph is the same program — only the compile is slower)
+        ladder = [_KZG_CELLS_RUNG_SMALL[:5]]
     elif mode == "epoch":
         # (validators, timeout) → run_inner's (sets, keys, validators,
         # batch, timeout) plumbing; on a wedged tunnel only the CPU-sized
@@ -1857,6 +1989,7 @@ def _main_measure(mode: str) -> None:
         "h2c": "h2c_points_per_s",
         "pairing": "pairing_sets_per_s",
         "slasher": "slashable_checks_per_s",
+        "kzg_cells": "kzg_cells_verified_per_s",
     }.get(mode, "bls_attestation_sets_verified_per_s")
     print(
         json.dumps(
@@ -1868,7 +2001,7 @@ def _main_measure(mode: str) -> None:
                     "epoch": "validators/s",
                     "epoch_sharded": "validators/s",
                     "h2c": "points/s", "pairing": "sets/s",
-                    "slasher": "checks/s",
+                    "slasher": "checks/s", "kzg_cells": "cells/s",
                 }.get(mode, "sets/s"),
                 "vs_baseline": 0.0,
                 "platform": platform,

@@ -149,6 +149,7 @@ def graph_registry(batch: int) -> list[tuple]:
     from ..bls import tpu_backend as tb
     from ..ops.bls import curve, fq, h2c, pairing, pallas_kernels as pk, plans, tower
     from ..ops.bls_oracle.fields import BLS_X
+    from ..ops.kzg import frops
 
     u64 = jnp.uint64
     B = (batch,)
@@ -289,6 +290,21 @@ def graph_registry(batch: int) -> list[tuple]:
          lambda a: pk.execute_plan(
              plans.FROB12, a, a, plans.PUB_BOUND, plans.PUB_BOUND, "frob12"
          ), (e12,)),
+        # ops/kzg/frops.py — the Fr (scalar-field) limb stack of the
+        # PeerDAS cell-proof engine (ISSUE 16), the SECOND field on the
+        # shared fq conv seam: RLC weight products, the interpolation dot,
+        # the batch-aggregation weighted sum, the wide fold/normalize
+        # reduction and the on-device MSM bit extraction. Each records its
+        # kzg.fr_* obligations (conv exactness, u64 accumulator headroom,
+        # fold-table coverage) via fq._cert at trace time, under every conv
+        # backend the five-pass CLI sweeps.
+        ("kzg.fr_mul", frops.fr_mul, (e1, e1)),
+        ("kzg.fr_dot", frops.fr_dot, (s(4, 25), s(4, 25))),
+        ("kzg.fr_weighted_sum",
+         lambda w, u: frops.fr_weighted_sum(w, u, batch), (e1, e1)),
+        ("kzg.fr_wide_reduce",
+         lambda t: frops.fr_wide_reduce(t, frops.R2_INT), (s(49),)),
+        ("kzg.fr_bits", frops.fr_bits, (e1,)),
         # slasher/kernels.py — the whole-registry surveillance sweep
         # (ISSUE 11): window roll + scatter + directional scans + candidate
         # flags over the span planes. Its obligations (u16 distance width,

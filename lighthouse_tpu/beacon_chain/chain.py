@@ -102,6 +102,13 @@ class BeaconChain:
         self.da_checker = DataAvailabilityChecker(
             spec, kzg=kzg, is_known=lambda root: root in self._seen_blocks
         )
+        # PeerDAS (ISSUE 16): the column cache is created HERE — not lazily
+        # by the network service — so every mutation happens under
+        # ``self.lock`` via ``put_data_column`` and the cache is pruned with
+        # the availability horizon instead of growing without bound.
+        self.data_column_cache: dict[bytes, dict[int, object]] = {}
+        self.cell_context = None  # CellContext when column sampling enabled
+        self.peerdas = None       # PeerDasSampler when enabled
         self.pubkey_cache = ValidatorPubkeyCache()
         self.pubkey_cache.import_new_pubkeys(genesis_state)
         # attester/shuffling cache tier (firehose/attester_cache.py): gossip
@@ -463,6 +470,57 @@ class BeaconChain:
             )
         return imported
 
+    # -- PeerDAS columns ----------------------------------------------------
+
+    def enable_peerdas(self, cell_ctx, node_id: bytes,
+                       custody_count: int | None = None,
+                       samples_per_slot: int | None = None):
+        """Turn on column sampling: availability for blob-carrying blocks is
+        then decided by the sampler's custody + sampled column set instead
+        of per-blob sidecar arrival (see ``peerdas.PeerDasSampler``)."""
+        from .peerdas import PeerDasSampler
+
+        kwargs = {}
+        if custody_count is not None:
+            kwargs["custody_count"] = custody_count
+        if samples_per_slot is not None:
+            kwargs["samples_per_slot"] = samples_per_slot
+        self.cell_context = cell_ctx
+        self.peerdas = PeerDasSampler(self, cell_ctx, node_id, **kwargs)
+        self.da_checker.set_column_gate(self.peerdas.is_available)
+        return self.peerdas
+
+    def put_data_column(self, sidecar) -> bytes:
+        """Retain a VERIFIED column sidecar, keyed by block root. All
+        mutation happens under the chain lock; the cache is LRU-bounded to
+        the availability checker's pending window and entries at or below
+        the finalized horizon are dropped."""
+        root = sidecar.signed_block_header.message.tree_root()
+        with self.lock:
+            cache = self.data_column_cache
+            cols = cache.pop(root, None) or {}
+            cols[int(sidecar.index)] = sidecar
+            cache[root] = cols
+            fin_slot = self.spec.start_slot(
+                int(self.fork_choice.store.finalized_checkpoint[0])
+            )
+            for r in [
+                r for r, cs in cache.items()
+                if r != root and cs and all(
+                    int(s.signed_block_header.message.slot) <= fin_slot
+                    for s in cs.values()
+                )
+            ]:
+                del cache[r]
+            while len(cache) > self.da_checker.MAX_PENDING:
+                cache.pop(next(iter(cache)))
+        return root
+
+    def data_columns_for(self, block_root: bytes) -> dict:
+        """Snapshot of the held columns for one block (index -> sidecar)."""
+        with self.lock:
+            return dict(self.data_column_cache.get(block_root, {}))
+
     def _notify_execution_layer(self, signed_block):
         """engine_newPayload for merge-era blocks; maps the EL verdict onto
         fork choice's optimistic-sync statuses (block_verification.rs
@@ -634,10 +692,19 @@ class BeaconChain:
 
     def _check_segment_availability(self, sb, block_root, blobs_by_root):
         """Deneb: segment blocks with commitments need their sidecars
-        verified (KZG batch + inclusion proofs) before import."""
+        verified (KZG batch + inclusion proofs) before import. With PeerDAS
+        enabled the gate is the column sampler instead: the block passes
+        once every custody + sampled column has been verified — the sync
+        manager couples the column fetch to the block download and retries
+        (block_sidecar_coupling.rs), so pending availability here is a
+        retriable condition, not a bad segment."""
         required = self.da_checker._required(sb)
         if required == 0:
             return
+        if self.cell_context is not None and self.peerdas is not None:
+            if self.peerdas.is_available(block_root):
+                return
+            raise BlockPendingAvailability(block_root)
         from .data_availability import BlobError
 
         sidecars = blobs_by_root.get(block_root)

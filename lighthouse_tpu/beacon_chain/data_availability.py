@@ -147,6 +147,16 @@ class DataAvailabilityChecker:
         # block_root -> {"block": signed_block | None, "blobs": {index: sidecar}}
         # insertion-ordered: oldest entries evicted past MAX_PENDING
         self._pending: dict[bytes, dict] = {}
+        # PeerDAS mode: when set, availability for blob-carrying blocks is
+        # decided by the sampling gate (custody + sampled columns verified)
+        # instead of per-blob sidecar arrival. fn(block_root) -> bool.
+        self.column_gate = None
+
+    def set_column_gate(self, gate) -> None:
+        """Switch this checker to column sampling (PeerDAS): ``gate`` is
+        called under the cache lock and must be non-blocking — it reads the
+        sampler's verified-column state, it never verifies in-line."""
+        self.column_gate = gate
 
     def _touch(self, root: bytes) -> dict:
         entry = self._pending.pop(root, None)
@@ -224,9 +234,28 @@ class DataAvailabilityChecker:
             entry["blobs"][int(sidecar.index)] = sidecar
             return self._check_available(root, entry)
 
+    def notify_columns(self, block_root: bytes):
+        """Column-sampling progress signal: re-evaluate a pending block
+        against the column gate. Returns the now-available (block, [])
+        or None (no pending block / gate still unsatisfied)."""
+        if self.is_known(block_root):
+            return None
+        with self._lock:
+            entry = self._pending.get(block_root)
+            if entry is None:
+                return None
+            return self._check_available(block_root, entry)
+
     def _check_available(self, root, entry):
         blk = entry["block"]
         if blk is None:
+            return None
+        if self.column_gate is not None:
+            # PeerDAS: the sampling state machine owns the verdict; blobs
+            # are reconstructed from columns, never waited on individually
+            if self.column_gate(root):
+                self._pending.pop(root, None)
+                return blk, []
             return None
         required = self._required(blk)
         comms = blk.message.body.blob_kzg_commitments

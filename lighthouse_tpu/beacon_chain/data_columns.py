@@ -113,7 +113,12 @@ def verify_data_column_sidecar(ns, sidecar, cell_ctx) -> None:
             # non-zero padding must fail — not be silently sliced away
             raise DataColumnError("cell padding not zero")
         cells.append(raw[: cell_ctx.bytes_per_cell])
-    ok = cell_ctx.verify_cell_kzg_proof_batch(
+    from ..kzg.engine import verify_cell_proof_batch
+
+    # backend-dispatched (LIGHTHOUSE_KZG_BACKEND): host per-cell loop or
+    # the device engine under the kzg_device ladder — fails CLOSED either way
+    ok = verify_cell_proof_batch(
+        cell_ctx,
         [bytes(c) for c in sidecar.kzg_commitments],
         [int(sidecar.index)] * len(sidecar.column),
         cells,

@@ -77,8 +77,12 @@ def _fft(vals: list[int], root: int, invert: bool = False) -> list[int]:
 class CellContext:
     """Cell geometry + domains for one trusted setup."""
 
-    def __init__(self, kzg: Kzg, cells_per_ext_blob: int = CELLS_PER_EXT_BLOB):
+    def __init__(self, kzg: Kzg, cells_per_ext_blob: int = CELLS_PER_EXT_BLOB,
+                 msm_backend: str | None = None):
         self.kzg = kzg
+        # every MSM below routes through the one kzg/msm.py dispatch seam;
+        # None defers to bls.get_backend() (the historical behaviour)
+        self.msm_backend = msm_backend
         self.n = kzg.n
         self.ext = 2 * self.n
         self.cells = min(cells_per_ext_blob, self.ext)
@@ -161,7 +165,10 @@ class CellContext:
             rem[i] = 0
         if any(rem[: self.k]):
             raise KzgError("cell does not lie on the blob polynomial")
-        proof = msm(self.kzg.setup.g1_monomial[: len(q)], q)
+        proof = msm(
+            self.kzg.setup.g1_monomial[: len(q)], q,
+            backend=self.msm_backend,
+        )
         return oc.g1_compress(proof)
 
     def compute_cells_and_kzg_proofs(
@@ -205,7 +212,10 @@ class CellContext:
         pts_t, z2 = self._coset_verify_consts(cell_index)
         pts = list(pts_t)
         interp = self._interpolant_coeffs(pts, vals)
-        i_commit = msm(self.kzg.setup.g1_monomial[: self.k], interp)
+        i_commit = msm(
+            self.kzg.setup.g1_monomial[: self.k], interp,
+            backend=self.msm_backend,
+        )
         from ..ops.bls_oracle.pairing import multi_pairing_is_one
 
         lhs = oc.g1_add(c_pt, oc.g1_neg(i_commit)) if c_pt else (

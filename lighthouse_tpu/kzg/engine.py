@@ -1,0 +1,527 @@
+"""Device-batched KZG cell-proof engine — the second cryptosystem on the
+plan compiler (ISSUE 16).
+
+``CellContext.verify_cell_kzg_proof_batch`` runs one host pairing per cell;
+this engine folds a whole batch into ONE combined pairing check on the
+device (see ``ops/kzg/verify`` for the math) behind the
+``LIGHTHOUSE_KZG_BACKEND = auto | device | host`` seam that mirrors the
+BLS / epoch / slasher seams:
+
+* ``host``   — the existing ``CellContext`` per-cell loop (parity oracle).
+* ``device`` — the batched graph: Fr limb math on the ``fq`` conv seam,
+  setup-time coset tables compiled as ``chain_plans`` fixed-scalar plans,
+  one ``scale_bits`` scan for every scalar multiply, one Miller product +
+  final exponentiation. Data-parallel over columns via the PR-10 shard
+  planner when more than one local device is visible (whole columns per
+  shard; each shard is still one combined check).
+* ``auto``   — ``device`` iff JAX is backed by an accelerator.
+
+The device path runs under the ``kzg_device`` resilience domain
+(injection stage ``kzg.cell_batch_verify``): ``device_full`` →
+``device_reduced`` (split halves, fresh transcripts) → ``cpu_oracle``
+(the host loop). A fully faulted ladder returns ``False`` — data
+availability FAILS CLOSED, a broken device can never mark a column
+verified.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+from ..ops.bls_oracle.fields import R
+from ..resilience import SupervisedFault, kzg_supervisor
+from .cells import CellContext
+from .kzg import Kzg, KzgError
+
+_BACKEND = os.environ.get("LIGHTHOUSE_KZG_BACKEND", "auto")
+_AUTO_DECISION: bool | None = None
+
+TRANSCRIPT_TAG = b"LHTPU_KZG_CELL_BATCH_V1"
+
+
+def set_kzg_backend(name: str) -> None:
+    global _BACKEND, _AUTO_DECISION
+    if name not in ("auto", "device", "host"):
+        raise ValueError(f"unknown kzg backend {name!r}")
+    _BACKEND = name
+    _AUTO_DECISION = None
+
+
+def get_kzg_backend() -> str:
+    return _BACKEND
+
+
+def _accelerator_present() -> bool:
+    global _AUTO_DECISION
+    if _AUTO_DECISION is None:
+        try:
+            import jax
+
+            _AUTO_DECISION = jax.devices()[0].platform in ("tpu", "gpu")
+        except Exception:  # noqa: BLE001 — no jax / no devices: host path
+            _AUTO_DECISION = False
+    return _AUTO_DECISION
+
+
+def device_backend_active() -> bool:
+    if _BACKEND == "host":
+        return False
+    if _BACKEND == "device":
+        return True
+    return _accelerator_present()
+
+
+# --------------------------------------------------------------------------------------
+# Host-side marshalling
+# --------------------------------------------------------------------------------------
+
+
+def _fq_limbs(vals) -> np.ndarray:
+    """Base-field ints -> uint64 [n, 25] limb rows (little-endian 16-bit)."""
+    raw = b"".join(int(v).to_bytes(50, "little") for v in vals)
+    return np.frombuffer(raw, dtype="<u2").reshape(len(vals), 25).astype(
+        np.uint64
+    )
+
+
+class _PointCache:
+    """Bytes-keyed bounded LRU over ``Kzg._parse_g1`` (columns repeat the
+    same commitments every slot; proofs are one-shot but cheap to keep)."""
+
+    def __init__(self, maxsize: int = 4096):
+        self._store: OrderedDict[bytes, object] = OrderedDict()
+        self._maxsize = maxsize
+
+    def parse(self, data: bytes, what: str):
+        hit = self._store.get(data)
+        if hit is not None:
+            self._store.move_to_end(data)
+            return hit[0]
+        pt = Kzg._parse_g1(data, what)  # raises KzgError on bad encodings
+        self._store[data] = (pt,)
+        if len(self._store) > self._maxsize:
+            self._store.popitem(last=False)
+        return pt
+
+
+# --------------------------------------------------------------------------------------
+# The engine
+# --------------------------------------------------------------------------------------
+
+
+class CellEngine:
+    """Device tables + jitted graphs for one ``CellContext`` geometry.
+
+    Everything static — the coset permutation, the shared inverse-NTT
+    matrix, descale/shift rows, the setup points, and the chain-plans
+    ``[tau^k - d_i]G2`` table — is built once (lazily, on first device
+    verify) and embedded into the jitted graphs as constants."""
+
+    def __init__(self, ctx: CellContext):
+        self.ctx = ctx
+        self._tables = None
+        self._z2_tab = None
+        self._points = _PointCache()
+        self._jit_batch = {}
+        self._jit_single = None
+
+    # -- table construction (host, once) -----------------------------------
+
+    def _build_tables(self):
+        if self._tables is not None:
+            return self._tables
+        import jax.numpy as jnp
+
+        from ..ops.bls import chain_plans, curve, g1 as dg1, g2 as dg2
+        from ..ops.bls_oracle import curves as oc
+        from ..ops.kzg import frops
+        from ..ops.kzg.verify import VerifyTables
+
+        ctx, k = self.ctx, self.ctx.k
+        # chunk order -> natural coset order must be the SAME static
+        # permutation for every coset (brp within the chunk); validate it
+        # against the context geometry for every cell index
+        order = {m: j for j, m in enumerate(ctx._mu_pows)}
+        perm = None
+        bases = []
+        for i in range(ctx.cells):
+            pts = ctx.coset_points(i)
+            c = ctx._coset_base(pts)
+            bases.append(c)
+            inv_c = pow(c, R - 2, R)
+            js = [order[p * inv_c % R] for p in pts]
+            pm = np.zeros(k, dtype=np.int64)
+            pm[js] = np.arange(k)
+            if perm is None:
+                perm = pm
+            elif not np.array_equal(perm, pm):
+                raise KzgError("coset chunk order is not uniform")
+        perm = perm.astype(np.int32)
+
+        inv_k = pow(k, R - 2, R)
+        inv_mu = pow(ctx.mu, R - 2, R)
+        idft = frops.fr_to_limbs(
+            [
+                pow(inv_mu, j * t, R) * inv_k % R
+                for t in range(k)
+                for j in range(k)
+            ]
+        ).reshape(k, k, 25)
+        cinv = frops.fr_to_limbs(
+            [
+                pow(c, (R - 2) * t, R)
+                for c in bases
+                for t in range(k)
+            ]
+        ).reshape(ctx.cells, k, 25)
+        d_ints = [pow(c, k, R) for c in bases]
+        dtab = frops.fr_to_limbs(d_ints)
+
+        setup = np.asarray(
+            dg1.from_oracle_batch(ctx.kzg.setup.g1_monomial[:k])
+        )
+        g2_gen = np.asarray(dg2.from_oracle(oc.g2_generator()))
+        t2 = np.asarray(dg2.from_oracle(ctx.kzg.setup.g2_monomial[k]))
+
+        self._tables = VerifyTables(
+            perm=perm, idft=np.asarray(idft), cinv=np.asarray(cinv),
+            dtab=np.asarray(dtab), setup=setup,
+            g2x=g2_gen[0:2], g2y=g2_gen[2:4], t2x=t2[0:2], t2y=t2[2:4],
+        )
+
+        # coset-shift table [tau^k - d_i]G2 as ONE chain-plans fixed-scalar
+        # plan: the d_i are host-known setup constants, so all ``cells``
+        # chains share a joint odd-multiple table and one scan
+        schedule = chain_plans.compile_chains(tuple(-d for d in d_ints))
+        gens = jnp.broadcast_to(
+            jnp.asarray(g2_gen), (ctx.cells,) + g2_gen.shape
+        )
+        neg_d_g2 = chain_plans.run_point_chains(2, gens, schedule)
+        t2_proj = jnp.broadcast_to(jnp.asarray(t2), neg_d_g2.shape)
+        self._z2_tab = np.asarray(curve.point_add(2, t2_proj, neg_d_g2))
+        return self._tables
+
+    # -- jitted graphs ------------------------------------------------------
+
+    def _batch_fn(self, n_pad: int):
+        fn = self._jit_batch.get(n_pad)
+        if fn is None:
+            import jax
+
+            from ..ops.kzg import verify
+
+            tables = self._build_tables()
+            fn = jax.jit(functools.partial(verify.cell_batch_check, tables))
+            self._jit_batch[n_pad] = fn
+        return fn
+
+    def _single_fn(self):
+        if self._jit_single is None:
+            import jax
+
+            from ..ops.kzg import verify
+
+            tables = self._build_tables()
+            self._jit_single = jax.jit(
+                functools.partial(
+                    verify.cell_single_check, self._z2_tab, tables=tables
+                )
+            )
+        return self._jit_single
+
+    # -- transcript ---------------------------------------------------------
+
+    def _rlc_weights(self, commitments, cell_indices, cells, proofs):
+        """Fiat-Shamir batch weights: one transcript hash over the whole
+        claim, then per-item field derivations (nonzero by construction —
+        a zero weight would let its cell escape the check)."""
+        from .fr import hash_to_bls_field
+
+        h = hashlib.sha256()
+        h.update(TRANSCRIPT_TAG)
+        h.update(self.ctx.cells.to_bytes(8, "little"))
+        h.update(self.ctx.k.to_bytes(8, "little"))
+        h.update(len(cells).to_bytes(8, "little"))
+        for c, i, cell, p in zip(commitments, cell_indices, cells, proofs):
+            h.update(c)
+            h.update(int(i).to_bytes(8, "little"))
+            h.update(cell)
+            h.update(p)
+        seed = h.digest()
+        return [
+            hash_to_bls_field(seed + j.to_bytes(8, "little")) or 1
+            for j in range(len(cells))
+        ]
+
+    # -- marshalling --------------------------------------------------------
+
+    def _marshal(self, commitments, cell_indices, cells, proofs, n_pad: int):
+        """Host lists -> padded device arrays. Raises KzgError on any
+        malformed input (caller maps that to a False verdict, like the
+        oracle). Pad rows carry (r = 0, v = 0, C = Q = inf): both sides of
+        the combined check see the identity."""
+        from ..ops.kzg import frops
+
+        ctx, n = self.ctx, len(cells)
+        r_ints = self._rlc_weights(commitments, cell_indices, cells, proofs)
+        vals: list[int] = []
+        c_pts, q_pts = [], []
+        for c, cell, p in zip(commitments, cells, proofs):
+            vals.extend(ctx._cell_to_fields(cell))
+            c_pts.append(self._points.parse(c, "commitment"))
+            q_pts.append(self._points.parse(p, "proof"))
+
+        pad = n_pad - n
+        v = np.zeros((n_pad, ctx.k, 25), dtype=np.uint64)
+        v[:n] = frops.fr_to_limbs(vals).reshape(n, ctx.k, 25)
+        r = np.zeros((n_pad, 25), dtype=np.uint64)
+        r[:n] = frops.fr_to_limbs(r_ints)
+        idx = np.zeros(n_pad, dtype=np.int32)
+        idx[:n] = np.asarray(cell_indices, dtype=np.int32)
+
+        def affine(pts):
+            inf = np.array(
+                [p is None for p in pts] + [True] * pad, dtype=bool
+            )
+            x = _fq_limbs(
+                [0 if p is None else p[0] for p in pts] + [0] * pad
+            )
+            y = _fq_limbs(
+                [0 if p is None else p[1] for p in pts] + [0] * pad
+            )
+            return x, y, inf
+
+        cx, cy, cinf = affine(c_pts)
+        qx, qy, qinf = affine(q_pts)
+        return v, r, idx, cx, cy, cinf, qx, qy, qinf
+
+    # -- verify -------------------------------------------------------------
+
+    def _check_shapes(self, commitments, cell_indices, cells, proofs):
+        if not (
+            len(commitments) == len(cell_indices) == len(cells) == len(proofs)
+        ):
+            return False
+        return all(0 <= int(i) < self.ctx.cells for i in cell_indices)
+
+    def _run_one(self, commitments, cell_indices, cells, proofs) -> bool:
+        from ..firehose.sharding import _bucket
+
+        n = len(cells)
+        if n == 0:
+            return True
+        n_pad = _bucket(n, floor=4)
+        try:
+            arrays = self._marshal(
+                commitments, cell_indices, cells, proofs, n_pad
+            )
+        except KzgError:
+            return False
+        return bool(np.asarray(self._batch_fn(n_pad)(*arrays)))
+
+    def verify_batch(
+        self, commitments, cell_indices, cells, proofs
+    ) -> bool:
+        """ONE combined pairing check for the whole batch (per shard when
+        a multi-device mesh splits columns)."""
+        if not self._check_shapes(commitments, cell_indices, cells, proofs):
+            return False
+        n = len(cells)
+        if n == 0:
+            return True
+        try:
+            import jax
+
+            n_dev = jax.local_device_count()
+        except Exception:  # noqa: BLE001 — no jax: host semantics
+            n_dev = 1
+        groups = _column_groups(cell_indices)
+        if n_dev > 1 and len(groups) > 1:
+            from ..firehose.sharding import plan_shards
+
+            plan = plan_shards(groups, min(n_dev, len(groups)))
+            for shard in plan.shard_items:
+                if not shard:
+                    continue
+                sel = list(shard)
+                if not self._run_one(
+                    [commitments[i] for i in sel],
+                    [cell_indices[i] for i in sel],
+                    [cells[i] for i in sel],
+                    [proofs[i] for i in sel],
+                ):
+                    return False
+            return True
+        return self._run_one(commitments, cell_indices, cells, proofs)
+
+    def verify_cell(
+        self, commitment: bytes, cell_index: int, cell: bytes, proof: bytes
+    ) -> bool:
+        """Single-cell device check through the chain-plans coset table."""
+        if not 0 <= int(cell_index) < self.ctx.cells:
+            return False
+        from ..ops.kzg import frops
+
+        try:
+            vals = self.ctx._cell_to_fields(cell)
+            c_pt = self._points.parse(commitment, "commitment")
+            q_pt = self._points.parse(proof, "proof")
+        except KzgError:
+            return False
+        self._build_tables()
+        v = frops.fr_to_limbs(vals).reshape(1, self.ctx.k, 25)
+        one = frops.fr_to_limbs([1])
+        idx = np.asarray([cell_index], dtype=np.int32)
+
+        def aff(p):
+            return (
+                _fq_limbs([0 if p is None else p[0]]),
+                _fq_limbs([0 if p is None else p[1]]),
+                np.asarray([p is None], dtype=bool),
+            )
+
+        cx, cy, cinf = aff(c_pt)
+        qx, qy, qinf = aff(q_pt)
+        return bool(
+            np.asarray(
+                self._single_fn()(v, one, idx, cx, cy, cinf, qx, qy, qinf)
+            )
+        )
+
+    # -- instrumentation ----------------------------------------------------
+
+    def compile_probe(self, batch: int) -> dict:
+        """Trace (don't run) the batch graph and report what the LOWERED
+        program contains: pairing checks, pairs per check, scale scans.
+        This is the 'one combined check per batch' proof the bench embeds."""
+        import jax
+
+        from ..ops.bls import fq
+        from ..ops.kzg import verify
+
+        n_pad = batch
+        tables = self._build_tables()
+        before = dict(verify.PROBE)
+        k = self.ctx.k
+        u64 = np.uint64
+        specs = (
+            jax.ShapeDtypeStruct((n_pad, k, 25), u64),      # v
+            jax.ShapeDtypeStruct((n_pad, 25), u64),          # r
+            jax.ShapeDtypeStruct((n_pad,), np.int32),        # idx
+            jax.ShapeDtypeStruct((n_pad, 25), u64),          # cx
+            jax.ShapeDtypeStruct((n_pad, 25), u64),          # cy
+            jax.ShapeDtypeStruct((n_pad,), bool),            # cinf
+            jax.ShapeDtypeStruct((n_pad, 25), u64),          # qx
+            jax.ShapeDtypeStruct((n_pad, 25), u64),          # qy
+            jax.ShapeDtypeStruct((n_pad,), bool),            # qinf
+        )
+        jax.jit(functools.partial(verify.cell_batch_check, tables)).lower(
+            *specs
+        )
+        return {
+            "batch": n_pad,
+            "pairing_checks_per_batch_trace": (
+                verify.PROBE["pairing_checks"] - before["pairing_checks"]
+            ),
+            "pairs_per_check": (
+                (verify.PROBE["pairs"] - before["pairs"])
+                // max(
+                    1,
+                    verify.PROBE["pairing_checks"]
+                    - before["pairing_checks"],
+                )
+            ),
+            "scale_scans_per_batch_trace": (
+                verify.PROBE["scale_scans"] - before["scale_scans"]
+            ),
+            "conv_impl": fq.conv_backend(),
+        }
+
+
+def _column_groups(cell_indices) -> list[list[int]]:
+    """Group batch positions by cell index (one data column repeats one
+    index per blob) — the shard planner's whole-group unit."""
+    by_col: dict[int, list[int]] = {}
+    for pos, i in enumerate(cell_indices):
+        by_col.setdefault(int(i), []).append(pos)
+    return [by_col[i] for i in sorted(by_col)]
+
+
+# --------------------------------------------------------------------------------------
+# Module-level dispatch (the seam everything above the kzg package calls)
+# --------------------------------------------------------------------------------------
+
+_engines: dict[int, tuple] = {}
+
+
+def get_engine(ctx: CellContext) -> CellEngine:
+    entry = _engines.get(id(ctx))
+    if entry is None:
+        entry = (ctx, CellEngine(ctx))
+        _engines[id(ctx)] = entry
+    return entry[1]
+
+
+def verify_cell_proof_batch(
+    ctx: CellContext, commitments, cell_indices, cells, proofs
+) -> bool:
+    """Backend-dispatched batch verification — THE entry point for data
+    availability. Host backend: the per-cell oracle loop. Device backend:
+    the batched engine under the ``kzg_device`` degradation ladder; a fully
+    faulted ladder FAILS CLOSED (returns False, the column stays
+    unverified)."""
+    if not (
+        len(commitments) == len(cell_indices) == len(cells) == len(proofs)
+    ):
+        return False
+    if not device_backend_active():
+        return ctx.verify_cell_kzg_proof_batch(
+            commitments, cell_indices, cells, proofs
+        )
+    # engine construction (table build + fixed-scalar chain compiles) is
+    # deferred INTO the device rungs: a ladder demoted to cpu_oracle — or
+    # one whose device rungs fault before running — never pays it
+    def device_full():
+        return get_engine(ctx).verify_batch(
+            commitments, cell_indices, cells, proofs
+        )
+
+    def device_reduced():
+        # halved batches, fresh transcripts: a shape-specific compile or
+        # size-dependent numeric fault on the full graph doesn't take the
+        # device path down with it
+        eng = get_engine(ctx)
+        mid = max(1, len(cells) // 2)
+        for lo, hi in ((0, mid), (mid, len(cells))):
+            if lo == hi:
+                continue
+            if not eng.verify_batch(
+                commitments[lo:hi], cell_indices[lo:hi],
+                cells[lo:hi], proofs[lo:hi],
+            ):
+                return False
+        return True
+
+    def cpu_oracle():
+        return ctx.verify_cell_kzg_proof_batch(
+            commitments, cell_indices, cells, proofs
+        )
+
+    try:
+        return bool(
+            kzg_supervisor().run_ladder(
+                "kzg.cell_batch_verify",
+                (
+                    ("device_full", device_full),
+                    ("device_reduced", device_reduced),
+                    ("cpu_oracle", cpu_oracle),
+                ),
+            )
+        )
+    except SupervisedFault:
+        return False  # fail CLOSED: never available off a faulted ladder

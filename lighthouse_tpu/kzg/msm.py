@@ -62,10 +62,21 @@ def pippenger(points, scalars, window: int = 8):
 
 
 def msm(points, scalars, backend: str | None = None):
-    """sum scalars[i] * points[i] (oracle affine in, oracle affine out)."""
+    """sum scalars[i] * points[i] (oracle affine in, oracle affine out).
+
+    THE MSM dispatch seam (ISSUE 16 satellite): every host-side setup MSM —
+    blob commitments, cell proofs, interpolant commitments, the engine's
+    table construction — funnels through here. ``backend`` accepts both the
+    kzg seam's names (``host`` / ``device``) and the bls seam's
+    (``oracle`` / ``native`` / ``tpu``); ``None`` defers to
+    ``bls.get_backend()`` as before."""
     from .. import bls
 
     backend = backend or bls.get_backend()
+    if backend in ("host", "oracle", "native"):
+        backend = "pippenger"
+    elif backend == "device":
+        backend = "tpu"
     if backend != "tpu":
         return pippenger(points, scalars)
 

@@ -119,6 +119,20 @@ class MessageCodec:
             raw = struct.pack(">QQ", start, count)
         elif method == "blocks_by_root":
             raw = b"".join(bytes(r) for r in payload)
+        elif method == "data_column_sidecars_by_root":
+            # DataColumnIdentifier stream: 32-byte root + u64 column index
+            raw = b"".join(
+                bytes(root) + struct.pack(">Q", int(idx))
+                for root, idx in payload
+            )
+        elif method == "data_column_sidecars_by_range":
+            start, count, columns = payload
+            cols = list(columns) if columns is not None else []
+            # column-count 0xFFFF is the "no filter" sentinel (None)
+            n = 0xFFFF if columns is None else len(cols)
+            raw = struct.pack(">QQH", start, count, n) + b"".join(
+                struct.pack(">H", int(c)) for c in cols
+            )
         else:
             raise WireError(f"no codec for rpc {method}")
         return zlib.compress(raw)
@@ -140,6 +154,20 @@ class MessageCodec:
             return struct.unpack(">QQ", raw)
         if method == "blocks_by_root":
             return [raw[i : i + 32] for i in range(0, len(raw), 32)]
+        if method == "data_column_sidecars_by_root":
+            return [
+                (raw[i : i + 32], struct.unpack(">Q", raw[i + 32 : i + 40])[0])
+                for i in range(0, len(raw), 40)
+            ]
+        if method == "data_column_sidecars_by_range":
+            start, count, n = struct.unpack(">QQH", raw[:18])
+            if n == 0xFFFF:
+                return start, count, None
+            cols = [
+                struct.unpack(">H", raw[18 + 2 * i : 20 + 2 * i])[0]
+                for i in range(n)
+            ]
+            return start, count, cols
         raise WireError(f"no codec for rpc {method}")
 
     def encode_response(self, method: str, payload) -> bytes:
@@ -147,6 +175,12 @@ class MessageCodec:
             return self.encode_request("status", payload)
         if method in ("blocks_by_range", "blocks_by_root"):
             parts = [self._enc_block(b) for b in payload]
+            raw = b"".join(struct.pack(">I", len(p)) + p for p in parts)
+            return zlib.compress(raw)
+        if method in (
+            "data_column_sidecars_by_root", "data_column_sidecars_by_range"
+        ):
+            parts = [self.ns.DataColumnSidecar.encode(sc) for sc in payload]
             raw = b"".join(struct.pack(">I", len(p)) + p for p in parts)
             return zlib.compress(raw)
         raise WireError(f"no codec for rpc response {method}")
@@ -160,6 +194,18 @@ class MessageCodec:
             while off < len(raw):
                 (n,) = struct.unpack(">I", raw[off : off + 4])
                 out.append(self._dec_block(raw[off + 4 : off + 4 + n]))
+                off += 4 + n
+            return out
+        if method in (
+            "data_column_sidecars_by_root", "data_column_sidecars_by_range"
+        ):
+            raw = zlib.decompress(data)
+            out, off = [], 0
+            while off < len(raw):
+                (n,) = struct.unpack(">I", raw[off : off + 4])
+                out.append(
+                    self.ns.DataColumnSidecar.decode(raw[off + 4 : off + 4 + n])
+                )
                 off += 4 + n
             return out
         raise WireError(f"no codec for rpc response {method}")

@@ -110,4 +110,11 @@ class Router:
             return self.svc.blocks_by_range(start_slot, count)
         if method == "blocks_by_root":
             return self.svc.blocks_by_root(payload)
+        if method == "data_column_sidecars_by_root":
+            return self.svc.data_column_sidecars_by_root(payload)
+        if method == "data_column_sidecars_by_range":
+            start_slot, count, columns = payload
+            return self.svc.data_column_sidecars_by_range(
+                start_slot, count, columns
+            )
         raise ValueError(f"unknown rpc method {method!r}")

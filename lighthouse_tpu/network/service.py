@@ -118,12 +118,24 @@ class BeaconNodeService:
             self.node_id, Topic.SYNC_CONTRIBUTION, signed_contribution
         )
 
+    def publish_data_column(self, sidecar) -> None:
+        self.transport.publish(
+            self.node_id, Topic.DATA_COLUMN_SIDECAR, sidecar
+        )
+
     # -- work handlers (network_beacon_processor/gossip_methods.rs) --------
 
     def process_gossip_block(self, item) -> None:
+        from ..beacon_chain.chain import BlockPendingAvailability
+
         block, from_peer = item
         try:
             self.chain.process_block(block)
+        except BlockPendingAvailability as e:
+            # PeerDAS: the block is parked until its columns verify; pull
+            # whatever custody/sample columns the proposer's side already
+            # serves, then re-check availability
+            self._fetch_missing_columns(e.block_root, from_peer)
         except BlockError as e:
             if "unknown parent" in str(e):
                 # single-block parent lookup (sync/block_lookups/), falling
@@ -166,10 +178,13 @@ class BeaconNodeService:
         self.chain.verify_sync_contributions([sc])
 
     def process_gossip_data_column(self, sidecar) -> None:
-        """PeerDAS column ingest groundwork: verify + retain by block root
+        """PeerDAS column ingest: verify, retain under the chain lock
+        (``chain.put_data_column`` — created in chain init, pruned with the
+        availability horizon), record sampling progress, and import any
+        block the new column completes
         (data_column_verification.rs gossip path)."""
         chain = self.chain
-        ctx = getattr(chain, "cell_context", None)
+        ctx = chain.cell_context
         if ctx is None:
             return  # column sampling not enabled on this node
         from ..beacon_chain.data_columns import (
@@ -181,24 +196,78 @@ class BeaconNodeService:
             verify_data_column_sidecar(chain.ns, sidecar, ctx)
         except DataColumnError:
             return  # invalid columns drop (peer scoring fires upstream)
-        cache = getattr(chain, "data_column_cache", None)
-        if cache is None:
-            cache = chain.data_column_cache = {}
-        root = sidecar.signed_block_header.message.tree_root()
-        cache.setdefault(root, {})[int(sidecar.index)] = sidecar
-        # bounded: drop column sets for slots at or below finality
-        fin_slot = chain.spec.start_slot(
-            int(chain.fork_choice.store.finalized_checkpoint[0])
-        )
-        if len(cache) > 64:
-            for r in [
-                r for r, cols in cache.items()
-                if any(
-                    int(s.signed_block_header.message.slot) <= fin_slot
-                    for s in cols.values()
+        root = chain.put_data_column(sidecar)
+        if chain.peerdas is None:
+            return
+        chain.peerdas.on_verified_column(root, int(sidecar.index))
+        self._try_column_availability(root)
+
+    def _try_column_availability(self, block_root: bytes) -> None:
+        """Re-evaluate a block against the sampling gate; reconstruct from
+        a >= 50% held column set when that's what closes the gap. Every
+        column marked verified here went through
+        ``verify_data_column_sidecar`` — reconstruction output included —
+        so a corrupt recovery can never flip a block to available."""
+        chain = self.chain
+        sampler = chain.peerdas
+        missing = sampler.missing_columns(block_root)
+        if missing and sampler.can_reconstruct(block_root):
+            from ..beacon_chain.data_columns import (
+                DataColumnError,
+                verify_data_column_sidecar,
+            )
+            from ..kzg.kzg import KzgError
+
+            try:
+                rebuilt = sampler.reconstruct(block_root)
+            except KzgError:
+                rebuilt = None  # inconsistent held data: stay unavailable
+            if rebuilt is not None:
+                for col in missing:
+                    sc = rebuilt[col]
+                    try:
+                        verify_data_column_sidecar(
+                            chain.ns, sc, chain.cell_context
+                        )
+                    except DataColumnError:
+                        return  # recovery produced garbage: fail closed
+                    chain.put_data_column(sc)
+                    sampler.on_verified_column(block_root, col)
+                    # re-seed the network with the recovered column (spec:
+                    # reconstructing nodes republish)
+                    self.publish_data_column(sc)
+        res = chain.da_checker.notify_columns(block_root)
+        if res is None:
+            return
+        blk, _ = res
+        with chain.lock:
+            try:
+                chain._process_block_locked(
+                    blk, blk.message, block_root, True,
+                    check_availability=False,
                 )
-            ]:
-                del cache[r]
+            except BlockError:
+                pass  # e.g. unknown parent: range sync re-imports it later
+
+    def _fetch_missing_columns(self, block_root: bytes, peer: str) -> None:
+        """Pull this node's missing custody/sample columns from a peer over
+        the DataColumnSidecarsByRoot Req/Resp, then retry availability."""
+        chain = self.chain
+        if chain.peerdas is None:
+            return
+        missing = chain.peerdas.missing_columns(block_root)
+        if not missing:
+            self._try_column_availability(block_root)
+            return
+        try:
+            sidecars = self.transport.request(
+                self.node_id, peer, "data_column_sidecars_by_root",
+                [(bytes(block_root), c) for c in missing],
+            )
+        except (ConnectionError, ValueError):
+            return  # peer gone / refused: gossip or sync will retry
+        for sc in sidecars:
+            self.process_gossip_data_column(sc)
 
     def process_gossip_exit(self, exit_msg) -> None:
         self.op_pool.insert_voluntary_exit(exit_msg)
@@ -246,3 +315,44 @@ class BeaconNodeService:
     def blocks_by_root(self, roots) -> list:
         blocks = (self.chain.get_signed_block(r) for r in roots)
         return [sb for sb in blocks if sb is not None]
+
+    def data_column_sidecars_by_root(self, identifiers) -> list:
+        """DataColumnSidecarsByRoot: serve held columns for
+        (block_root, column_index) pairs (rpc_methods.rs
+        DataColumnsByRootRequest). Unknown identifiers are skipped —
+        responses carry only what this node custodies."""
+        out = []
+        for root, idx in identifiers:
+            sc = self.chain.data_columns_for(bytes(root)).get(int(idx))
+            if sc is not None:
+                out.append(sc)
+        return out
+
+    def data_column_sidecars_by_range(
+        self, start_slot: int, count: int, columns=None
+    ) -> list:
+        """DataColumnSidecarsByRange: held columns for slots in
+        [start_slot, start_slot + count), optionally filtered to a column
+        subset; (slot, index)-ordered like the reference's response
+        stream."""
+        with self.chain.lock:
+            snapshot = [
+                sc
+                for cols in self.chain.data_column_cache.values()
+                for sc in cols.values()
+            ]
+        wanted = None if columns is None else {int(c) for c in columns}
+        out = [
+            sc
+            for sc in snapshot
+            if start_slot
+            <= int(sc.signed_block_header.message.slot)
+            < start_slot + count
+            and (wanted is None or int(sc.index) in wanted)
+        ]
+        out.sort(
+            key=lambda sc: (
+                int(sc.signed_block_header.message.slot), int(sc.index)
+            )
+        )
+        return out

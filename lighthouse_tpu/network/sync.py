@@ -205,16 +205,40 @@ class SyncManager:
                 continue
             if not blocks:
                 return 0
-            try:
-                # direct call, NOT processor.submit: the synchronous
-                # processor drains every queue, so a failure raised here
-                # could belong to a concurrent submitter's work and the
-                # demotion would hit the wrong peer
-                self.svc.process_chain_segment_strict(blocks)
+            if self._import_segment(blocks, peer, "bad segment"):
                 return len(blocks)
-            except Exception as e:  # noqa: BLE001 — bad segment
-                self._demote(peer, f"bad segment: {e}")
         return None
+
+    def _import_segment(self, blocks, peer: str, label: str) -> bool:
+        """Import a downloaded segment, coupling PeerDAS column downloads
+        to the block download (block_sidecar_coupling.rs): a block parked
+        on column availability pulls its missing custody/sample columns
+        from the serving peer by root and the import retries. A peer that
+        cannot close the gap rotates WITHOUT a strike — pending
+        availability is a property of the data, not peer misbehavior; only
+        segments that fail verification demote.
+
+        Direct call, NOT processor.submit: the synchronous processor
+        drains every queue, so a failure raised here could belong to a
+        concurrent submitter's work and the demotion would hit the wrong
+        peer."""
+        from ..beacon_chain.chain import BlockPendingAvailability
+
+        fetch = getattr(self.svc, "_fetch_missing_columns", None)
+        pending_seen: set[bytes] = set()
+        while True:
+            try:
+                self.svc.process_chain_segment_strict(blocks)
+                return True
+            except BlockPendingAvailability as e:
+                root = bytes(e.block_root)
+                if fetch is None or root in pending_seen:
+                    return False  # this peer can't close the gap: rotate
+                pending_seen.add(root)
+                fetch(root, peer)
+            except Exception as e:  # noqa: BLE001 — bad segment
+                self._demote(peer, f"{label}: {e}")
+                return False
 
     # -- backfill sync (backwards) -----------------------------------------
 
@@ -341,10 +365,7 @@ class SyncManager:
         else:
             log.warn("Parent chain deeper than lookup tolerance")
             return
-        try:
-            self.svc.process_chain_segment_strict(segment)
-        except Exception as e:  # noqa: BLE001
-            self._demote(from_peer, f"unviable lookup segment: {e}")
+        self._import_segment(segment, from_peer, "unviable lookup segment")
 
     def _lookup_by_root(self, root: bytes, prefer: str | None = None):
         """BlocksByRoot from the preferring peer first, then rotation. The

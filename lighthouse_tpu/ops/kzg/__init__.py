@@ -1,0 +1,21 @@
+"""Device KZG kernels: scalar-field (Fr) limb math + batched cell verify.
+
+The second cryptosystem on the plan compiler (ISSUE 16): everything here
+rides the ``ops/bls`` machinery — the 25x16-bit limb layout and the
+``fq._conv_product`` seam (so all three ``LIGHTHOUSE_CONV_IMPL`` backends
+work unchanged), ``curve.scale_bits``/``point_sum`` for the MSMs,
+``chain_plans`` for the setup-time fixed-scalar tables, and
+``pairing.miller_product`` for the one combined pairing check per batch.
+
+* ``frops``  — Fr (BLS12-381 scalar field) arithmetic in the limb domain:
+  products through the conv seam, dot products as conv-accumulator sums,
+  and the fold/normalize/conditional-subtract reduction mod r with every
+  bound recorded through ``fq._cert`` (the bounds certifier picks the
+  ``kzg.*`` obligations up like any other op graph).
+* ``verify`` — the batched cell-proof verification graph: device
+  interpolation (uniform bit-reversal + one shared inverse-NTT matrix +
+  per-coset descale), random-linear-combination aggregation, three MSMs
+  (one with device-computed scalars), and ONE 2-pair Miller product.
+"""
+
+from . import frops, verify  # noqa: F401

@@ -1,0 +1,159 @@
+"""Fr (BLS12-381 scalar field) limb arithmetic on the fq conv seam.
+
+Fr elements reuse the 25x16-bit uint64 limb layout of ``ops/bls/fq`` —
+canonical values (< r, 255 bits) occupy the low 16 limbs, the top 9 limbs
+are zero — so the multiply pipeline is exactly the base-field one:
+``fq._conv_product`` (dispatched to pallas / digits / f64 / shear by
+``LIGHTHOUSE_CONV_IMPL``) produces 50 exact u64 accumulators in the 16-bit
+radix, dot products SUM those accumulators in u64 (exact far below 2^64 for
+every batch shape we run), and one ``fr_wide_reduce`` brings the wide value
+back to canonical form mod r:
+
+    carry-normalize to exact 16-bit limbs
+      -> fold limbs >= 16 with rows 2^(16*(16+j)) mod r  (repeat; each tail
+         round shaves ~3.3 bits since 2^256 mod r ~ 2^252.7)
+      -> conditional-subtract ladder of 2r, r
+
+Every static bound the walk relies on is asserted AND recorded through
+``fq._cert`` under ``kzg.*`` kinds, so ``analysis/bounds`` certifies these
+graphs beside the BLS ones.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..bls import fq
+from ..bls_oracle.fields import R as R_INT
+
+NLIMBS = fq.NLIMBS
+LIMB_BITS = fq.LIMB_BITS
+R2_INT = R_INT * R_INT
+
+# fold rows: 2^(16*(16+j)) mod r as exact 16-limb arrays (j up to 24 covers
+# wide values through 2^640 — far past the 2^522 worst case we certify)
+_N_FOLD = 24
+_FOLD_INT = [pow(2, LIMB_BITS * (16 + j), R_INT) for j in range(_N_FOLD)]
+_FOLD_TAB = np.stack(
+    [np.asarray(fq.int_to_limbs(v))[:16] for v in _FOLD_INT]
+).astype(np.uint64)
+
+# conditional-subtract ladder constants (25-limb, exact 16-bit limbs)
+_MR_LIMBS = {m: np.asarray(fq.int_to_limbs(m * R_INT)) for m in (2, 1)}
+
+# MSB-first bit extraction tables: bit m (m=0 is bit 254) lives in
+# limb pos//16 at offset pos%16 with pos = 254 - m
+_BIT_POS = np.arange(254, -1, -1)
+_BIT_LIMB = (_BIT_POS // LIMB_BITS).astype(np.int32)
+_BIT_OFF = (_BIT_POS % LIMB_BITS).astype(np.uint64)
+
+
+def fr_to_limbs(vals) -> np.ndarray:
+    """Host: iterable of canonical ints -> uint64 [n, 25] limb rows."""
+    vals = list(vals)
+    raw = b"".join(int(v).to_bytes(32, "little") for v in vals)
+    a = np.frombuffer(raw, dtype="<u2").reshape(len(vals), 16)
+    out = np.zeros((len(vals), NLIMBS), dtype=np.uint64)
+    out[:, :16] = a
+    return out
+
+
+def limbs_to_fr(a) -> int:
+    """Host: one canonical limb row -> Python int."""
+    return fq.limbs_to_int(a)
+
+
+def fr_wide_reduce(t, value_bound: int):
+    """Wide 16-bit-radix u64 accumulator [..., L] with value < value_bound
+    -> canonical Fr limbs [..., 25]. The fold/normalize schedule is resolved
+    statically from ``value_bound`` at trace time (no data-dependent
+    control flow reaches the device)."""
+    assert fq._cert(
+        "kzg.fr_reduce.in_value", value_bound, 1 << (LIMB_BITS * 40),
+        note="wide Fr value fits the fold table",
+    ), "fr_wide_reduce input bound exceeds the fold table"
+    def _normalize(t, width):
+        # _carry_propagate slices to ``width``; pad first so carries can
+        # spill into the high limbs the value is entitled to
+        if t.shape[-1] < width:
+            t = jnp.pad(t, [(0, 0)] * (t.ndim - 1) + [(0, width - t.shape[-1])])
+        return fq._carry_propagate(t, width)
+
+    width = max(16, -(-value_bound.bit_length() // LIMB_BITS))
+    t = _normalize(t, width)  # exact 16-bit limbs, value-preserving
+    vb = value_bound
+    while width > 16 and vb > (1 << 256) + _FOLD_INT[0]:
+        hi_w = width - 16
+        caps = [
+            min((1 << LIMB_BITS) - 1, vb >> (LIMB_BITS * (16 + j)))
+            for j in range(hi_w)
+        ]
+        # fold contribution per output limb: sum_j cap_j * 0xFFFF, plus the
+        # 16-bit low limb — far inside u64 (certified, not assumed)
+        limb_bound = ((1 << LIMB_BITS) - 1) * (1 + sum(caps))
+        assert fq._cert(
+            "kzg.fr_reduce.fold_limb", limb_bound, (1 << 63) - 1,
+            note="fold accumulator limbs stay exact in u64",
+        ), "fr fold accumulator would overflow"
+        lo = t[..., :16]
+        hi = t[..., 16:width]
+        fold = (hi[..., :, None] * jnp.asarray(_FOLD_TAB[:hi_w])).sum(axis=-2)
+        vb = (1 << 256) - 1 + sum(c * f for c, f in zip(caps, _FOLD_INT))
+        width = max(16, -(-vb.bit_length() // LIMB_BITS))
+        t = _normalize(lo + fold, width)
+    assert fq._cert(
+        "kzg.fr_reduce.tail", vb, 4 * R_INT,
+        note="post-fold value inside the 2r/r subtract ladder",
+    ), "fr fold walk did not converge below 4r"
+    pad = [(0, 0)] * (t.ndim - 1) + [(0, NLIMBS - t.shape[-1])]
+    t = jnp.pad(t, pad)
+    for m in (2, 1):
+        diff, borrow = fq._sub_limbs(t, jnp.asarray(_MR_LIMBS[m]))
+        t = jnp.where((borrow == 1)[..., None], t, diff)
+    return t
+
+
+def fr_mul(a, b):
+    """Canonical [..., 25] x [..., 25] -> canonical product mod r. Runs on
+    whichever conv backend ``LIGHTHOUSE_CONV_IMPL`` selects."""
+    fq.conv_limb_bounds((1 << LIMB_BITS) - 1)  # certify conv exactness
+    return fr_wide_reduce(fq._conv_product(a, b), R2_INT)
+
+
+def fr_dot(a, b):
+    """sum_j a[..., j, :] * b[..., j, :] mod r for canonical inputs
+    [..., K, 25]: K conv products summed as u64 accumulators (exact — the
+    per-limb bound is certified), then ONE reduction."""
+    k = a.shape[-2]
+    conv_bound = max(fq.conv_limb_bounds((1 << LIMB_BITS) - 1))
+    assert fq._cert(
+        "kzg.fr_dot.acc", k * conv_bound, (1 << 63) - 1,
+        note="summed conv accumulators stay exact in u64",
+    ), "fr_dot accumulator would overflow"
+    t = fq._conv_product(a, b).sum(axis=-2)
+    return fr_wide_reduce(t, k * R2_INT)
+
+
+def fr_weighted_sum(w, u, batch: int):
+    """sum over the LEADING axis of w*u mod r (w, u: [B, ..., 25] canonical;
+    ``batch`` must equal the static leading extent). The aggregation stage
+    of the batched verifier: one conv per pair, one u64 accumulator sum over
+    the batch, one reduction per output element."""
+    assert w.shape[0] == batch and u.shape[0] == batch
+    conv_bound = max(fq.conv_limb_bounds((1 << LIMB_BITS) - 1))
+    assert fq._cert(
+        "kzg.fr_wsum.acc", batch * conv_bound, (1 << 63) - 1,
+        note="batch-summed conv accumulators stay exact in u64",
+    ), "fr_weighted_sum accumulator would overflow"
+    t = fq._conv_product(w, u).sum(axis=0)
+    return fr_wide_reduce(t, batch * R2_INT)
+
+
+def fr_bits(s):
+    """Canonical limbs [..., 25] -> uint64 bit plane [255, ...] MSB-first
+    (the ``curve.scale_bits`` input layout). On-device bit extraction: the
+    MSM over device-computed scalars never round-trips to the host."""
+    v = s[..., jnp.asarray(_BIT_LIMB)]
+    bits = (v >> jnp.asarray(_BIT_OFF)) & jnp.uint64(1)
+    return jnp.moveaxis(bits, -1, 0)

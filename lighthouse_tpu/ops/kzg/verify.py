@@ -1,0 +1,174 @@
+"""Batched KZG cell-proof verification: ONE combined pairing check per batch.
+
+Per cell (EIP-7594 verify_cell_kzg_proof): with coset H_i = {c_i mu^t},
+d_i = c_i^k, interpolant I_i of the cell values on H_i, and proof Q_i,
+
+    e(C_i - [I_i(tau)], G2) * e(-Q_i, [tau^k - d_i]G2) == 1.
+
+Expanding the second pair through T2 = [tau^k]G2 and folding the whole
+batch with Fiat-Shamir weights r_i turns B checks into TWO pairs:
+
+    e( sum_i r_i (C_i - [I_i] + d_i Q_i),  G2 )
+  * e( -sum_i r_i Q_i,                     T2 )  ==  1
+
+where sum_i r_i [I_i] is ONE trusted-setup MSM with device-computed
+scalars: cell values arrive in bit-reversed coset order, so a single
+static gather (the k-point bit-reversal, an involution) plus one shared
+k x k inverse-NTT matrix over mu and a per-coset descale c_i^{-t} yields
+the monomial interpolant coefficients,
+
+    a_{i,t} = c_i^{-t} * U_{i,t},   U_i = M v'_i,  M[t,j] = mu^{-jt}/k,
+
+and the aggregated setup scalars s_t = sum_i (r_i c_i^{-t}) U_{i,t} are
+one ``frops.fr_weighted_sum`` per coefficient row. Every scalar multiply
+in the graph — C/Q weights, d-shifted Q weights, and the setup scalars —
+funnels into ONE ``curve.scale_bits`` scan over 3B + k lanes, two halving
+point trees, and one backend-dispatched Miller product with a single final
+exponentiation.
+
+``PROBE`` counts trace-time pairing checks/pairs: jit tracing runs this
+module's Python once per compile, so a probe of exactly one
+``multi_pairing_is_one`` with two pairs is a property of the LOWERED
+graph, not of runtime logging (the bench embeds the record).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..bls import curve, pairing
+from . import frops
+
+# trace-time instrumentation (see module docstring)
+PROBE = {"pairing_checks": 0, "pairs": 0, "scale_scans": 0}
+
+
+class VerifyTables(NamedTuple):
+    """Static per-context constants (host-built once per CellContext).
+
+    perm   int32  [k]          bit-reversal chunk order -> natural coset order
+    idft   uint64 [k, k, 25]   M[t, j] = mu^{-jt} / k mod r (Fr limbs)
+    cinv   uint64 [cells, k, 25]  c_i^{-t} descale rows
+    dtab   uint64 [cells, 25]  d_i = c_i^k
+    setup  uint64 [k, 3, 25]   G1 monomial setup points (projective)
+    g2x/y  uint64 [2, 25]      G2 generator (affine Fq2)
+    t2x/y  uint64 [2, 25]      [tau^k]G2 (affine Fq2)
+    """
+
+    perm: np.ndarray
+    idft: np.ndarray
+    cinv: np.ndarray
+    dtab: np.ndarray
+    setup: np.ndarray
+    g2x: np.ndarray
+    g2y: np.ndarray
+    t2x: np.ndarray
+    t2y: np.ndarray
+
+
+def interpolate_rows(tables: VerifyTables, v):
+    """Cell values [B, k, 25] (bit-reversed coset order) -> mu-basis
+    interpolant rows U [B, k, 25]: static permutation gather + the shared
+    inverse-NTT matrix, one IDFT row per scan step (peak memory one
+    [B, k, 50] conv accumulator instead of the full [B, k, k, 50])."""
+    nat = jnp.take(v, jnp.asarray(tables.perm), axis=1)
+
+    def row(_, m_row):
+        return None, frops.fr_dot(nat, m_row)
+
+    _, u = jax.lax.scan(row, None, jnp.asarray(tables.idft))
+    return jnp.moveaxis(u, 0, 1)
+
+
+def cell_batch_check(tables: VerifyTables, v, r, idx, cx, cy, cinf, qx, qy,
+                     qinf):
+    """The ONE-combined-check verification graph.
+
+    v    [B, k, 25]  cell field elements (canonical Fr limbs)
+    r    [B, 25]     Fiat-Shamir weights (canonical, nonzero)
+    idx  int32 [B]   cell/coset indices
+    cx/cy/cinf, qx/qy/qinf: commitment / proof affine Fq limbs [B, 25]
+                     + infinity masks [B]
+
+    Returns a scalar bool. Zero-weight rows (r_i = 0) contribute the
+    identity on both sides, so callers pad ragged batches with
+    (r=0, C=Q=inf) rows to keep shapes bucketed.
+    """
+    b = v.shape[0]
+    u = interpolate_rows(tables, v)
+
+    # per-cell descaled weights and the aggregated setup scalars
+    cinv_g = jnp.take(jnp.asarray(tables.cinv), idx, axis=0)
+    w = frops.fr_mul(r[:, None, :], cinv_g)          # [B, k, 25]
+    s = frops.fr_weighted_sum(w, u, b)               # [k, 25]
+
+    rd = frops.fr_mul(r, jnp.take(jnp.asarray(tables.dtab), idx, axis=0))
+
+    # every scalar multiply in one scan: C by r, Q by r*d, Q by r, setup by s
+    c_pt = curve.from_affine(1, cx[:, None, :], cy[:, None, :], inf=cinf)
+    q_pt = curve.from_affine(1, qx[:, None, :], qy[:, None, :], inf=qinf)
+    setup_neg = curve.point_neg(1, jnp.asarray(tables.setup))
+    pts = jnp.concatenate([c_pt, q_pt, q_pt, setup_neg], axis=0)
+    bits = jnp.concatenate(
+        [frops.fr_bits(r), frops.fr_bits(rd), frops.fr_bits(r),
+         frops.fr_bits(s)],
+        axis=1,
+    )
+    scaled = curve.scale_bits(1, pts, bits)          # [3B + k, 3, 25]
+    PROBE["scale_scans"] += 1
+
+    # lhs = sum r_i C_i + sum r_i d_i Q_i - sum s_t setup_t
+    lhs = curve.point_sum(
+        1, jnp.concatenate([scaled[: 2 * b], scaled[3 * b :]], axis=0)
+    )
+    q_neg = curve.point_neg(1, curve.point_sum(1, scaled[2 * b : 3 * b]))
+
+    lx, ly = curve.to_affine(1, lhs)
+    nx, ny = curve.to_affine(1, q_neg)
+    px = jnp.stack([lx[0], nx[0]], axis=0)
+    py = jnp.stack([ly[0], ny[0]], axis=0)
+    g2qx = jnp.stack([jnp.asarray(tables.g2x), jnp.asarray(tables.t2x)])
+    g2qy = jnp.stack([jnp.asarray(tables.g2y), jnp.asarray(tables.t2y)])
+    # an infinity side contributes e(inf, .) = 1: mask it valid=False
+    valid = jnp.stack([~curve.is_inf(1, lhs), ~curve.is_inf(1, q_neg)])
+    PROBE["pairing_checks"] += 1
+    PROBE["pairs"] += 2
+    return pairing.multi_pairing_is_one(px, py, g2qx, g2qy, valid)
+
+
+def cell_single_check(z2_tab, v, r_one, idx, cx, cy, cinf, qx, qy, qinf,
+                      tables: VerifyTables):
+    """Single-cell device check against the chain-plans coset table
+    ``z2_tab`` ([cells, 6, 25] projective [tau^k - d_i]G2 rows): the direct
+    two-pair form e(C - [I], G2) * e(-Q, Z_i) == 1 without RLC weights.
+    Shapes are the B = 1 slice of the batch layout."""
+    u = interpolate_rows(tables, v)                  # [1, k, 25]
+    cinv_g = jnp.take(jnp.asarray(tables.cinv), idx, axis=0)
+    a = frops.fr_mul(r_one[:, None, :], cinv_g)      # r_one = 1: descale only
+    s = frops.fr_weighted_sum(a, u, 1)               # [k, 25]
+
+    setup_scaled = curve.scale_bits(
+        1, jnp.asarray(tables.setup), frops.fr_bits(s)
+    )
+    i_commit = curve.point_sum(1, setup_scaled)
+    c_pt = curve.from_affine(1, cx[:, None, :], cy[:, None, :], inf=cinf)[0]
+    q_pt = curve.from_affine(1, qx[:, None, :], qy[:, None, :], inf=qinf)[0]
+    lhs = curve.point_add(1, c_pt, curve.point_neg(1, i_commit))
+    q_neg = curve.point_neg(1, q_pt)
+
+    z2 = jnp.take(jnp.asarray(z2_tab), idx[0], axis=0)
+    z2x, z2y = curve.to_affine(2, z2)
+    lx, ly = curve.to_affine(1, lhs)
+    nx, ny = curve.to_affine(1, q_neg)
+    px = jnp.stack([lx[0], nx[0]], axis=0)
+    py = jnp.stack([ly[0], ny[0]], axis=0)
+    g2qx = jnp.stack([jnp.asarray(tables.g2x), z2x])
+    g2qy = jnp.stack([jnp.asarray(tables.g2y), z2y])
+    valid = jnp.stack([~curve.is_inf(1, lhs), ~curve.is_inf(1, q_neg)])
+    PROBE["pairing_checks"] += 1
+    PROBE["pairs"] += 2
+    return pairing.multi_pairing_is_one(px, py, g2qx, g2qy, valid)

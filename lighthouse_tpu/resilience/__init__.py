@@ -27,6 +27,11 @@ Canonical fault domains:
   (``epoch_engine.engine.process_epoch_on_device``).
 * ``slasher_supervisor()`` — the device-resident slasher span store
   (``slasher.engine.SpanStore``; injection stage ``slasher.sweep``).
+* ``kzg_supervisor()`` — the device-batched KZG cell-proof engine
+  (``kzg.engine.verify_cell_proof_batch``; injection stage
+  ``kzg.cell_batch_verify`` with rungs ``device_full`` / ``device_reduced``
+  / ``cpu_oracle``). Data availability fails CLOSED: a fully faulted
+  ladder returns "not verified", never "available".
 """
 
 from __future__ import annotations
@@ -67,6 +72,7 @@ from .supervisor import (  # noqa: F401
 BLS_DOMAIN = "bls_device"
 EPOCH_DOMAIN = "epoch_device"
 SLASHER_DOMAIN = "slasher_device"
+KZG_DOMAIN = "kzg_device"
 
 
 def bls_supervisor() -> BackendSupervisor:
@@ -85,6 +91,14 @@ def slasher_supervisor() -> BackendSupervisor:
     checkpoint + replays the pair journal on the numpy twin, so demotion
     never drops evidence."""
     return get_supervisor(SLASHER_DOMAIN)
+
+
+def kzg_supervisor() -> BackendSupervisor:
+    """The fault domain guarding device-batched KZG cell verification
+    (``kzg/engine.py``). A column whose proof batch cannot be verified on
+    ANY rung is treated as unverified — the availability checker never
+    marks a block available off a faulted ladder (fail closed)."""
+    return get_supervisor(KZG_DOMAIN)
 
 
 def health_snapshot() -> dict:

@@ -25,6 +25,8 @@ recovery invariants.
 
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 
 from ..network import BeaconNodeService, LoopbackTransport
@@ -130,6 +132,10 @@ class LocalNetwork:
             for svc in self.nodes:
                 self._attach_slasher(svc)
         self._msg_total = 0  # messages published so far (settle accounting)
+        # PeerDAS (ISSUE 16): armed by enable_peerdas(); slot -> blob plan
+        self.cell_ctx = None
+        self._peerdas_cfg = None
+        self._blob_plan: dict[int, tuple[list, set[int]]] = {}
 
     def _make_store(self, i: int):
         """Per-node WAL-backed hot/cold store under ``datadir`` (or None).
@@ -330,11 +336,65 @@ class LocalNetwork:
         self.dead.discard(i)
         if self.slasher_enabled:
             self._attach_slasher(svc)
+        if self._peerdas_cfg is not None:
+            # same node id digest => same custody set as before the crash
+            self._enable_peerdas_on(svc)
         for peer in self.transport.peers(exclude=svc.node_id):
             try:
                 svc.connect(peer)
             except ConnectionError:
                 pass
+
+    # -- PeerDAS (ISSUE 16) ------------------------------------------------
+
+    def enable_peerdas(self, cell_ctx, custody_count: int | None = None,
+                       samples_per_slot: int | None = None) -> None:
+        """Arm column sampling on every node: each gets a deterministic
+        node-id digest (so custody sets differ per node but are stable
+        across restarts) and blob-carrying proposals gate availability on
+        the sampler's custody + sampled columns."""
+        assert self.mode == "loopback", "peerdas churn drives the loopback sim"
+        self.cell_ctx = cell_ctx
+        self._peerdas_cfg = (cell_ctx, custody_count, samples_per_slot)
+        for svc in self.nodes:
+            self._enable_peerdas_on(svc)
+
+    def _enable_peerdas_on(self, svc) -> None:
+        ctx, custody, samples = self._peerdas_cfg
+        svc.chain.enable_peerdas(
+            ctx,
+            hashlib.sha256(svc.node_id.encode()).digest(),
+            custody_count=custody,
+            samples_per_slot=samples,
+        )
+
+    def schedule_blobs(self, slot: int, blobs: list,
+                       withhold: set[int] | None = None) -> None:
+        """The proposal at ``slot`` carries ``blobs`` as KZG commitments;
+        columns whose index is in ``withhold`` are never built onto the
+        wire (the withholding-attack scenario — the block must stay
+        unavailable everywhere unless reconstruction can cover them)."""
+        self._blob_plan[int(slot)] = (list(blobs), set(withhold or ()))
+
+    def retry_columns(self, block_root: bytes) -> None:
+        """Sampler retry tick: every live node with missing required
+        columns re-fetches them over by-root RPC from each live peer (the
+        gossip-loss repair path; reconstruction kicks in inside the
+        availability check once >= 50% of columns are held)."""
+        for i, svc in enumerate(self.nodes):
+            if i in self.dead or svc.chain.peerdas is None:
+                continue
+            if not svc.chain.peerdas.missing_columns(block_root):
+                self._guarded(svc._try_column_availability, block_root)
+                continue
+            for j, peer in enumerate(self.nodes):
+                if j == i or j in self.dead:
+                    continue
+                self._guarded(
+                    svc._fetch_missing_columns, block_root, peer.node_id
+                )
+                if not svc.chain.peerdas.missing_columns(block_root):
+                    break
 
     # -- per-slot duties ---------------------------------------------------
 
@@ -364,23 +424,75 @@ class LocalNetwork:
             chain.head.state, slot, reveal, attestations=atts,
             op_pool=node.op_pool,
         )
+        plan = self._blob_plan.get(slot)
+        if plan is not None and self.cell_ctx is not None:
+            # blob-carrying proposal: graft the commitments onto the
+            # produced body, then recompute state_root against the SAME
+            # pre-state the block was built on (the harness's genesis-based
+            # resign recipe would miss every imported block)
+            blobs, _withhold = plan
+            block.body.blob_kzg_commitments = [
+                self.cell_ctx.kzg.blob_to_kzg_commitment(b) for b in blobs
+            ]
+            from ..state_transition import (
+                BlockSignatureStrategy,
+                per_block_processing,
+            )
+
+            fork = spec.fork_name_at_epoch(epoch)
+            block_cls = node.chain.ns.block_types[fork]
+            trial = chain.head.state.copy()
+            if trial.slot < slot:
+                process_slots(spec, trial, slot)
+            block.state_root = b"\x00" * 32
+            per_block_processing(
+                spec, trial, block_cls(message=block, signature=b"\x00" * 96),
+                strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                verify_block_root=False,
+            )
+            block.state_root = trial.tree_root()
         fork = spec.fork_name_at_epoch(epoch)
         block_cls = node.chain.ns.block_types[fork]
         domain_b = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=epoch)
         sig = self.harness._sign(proposer, compute_signing_root(block, domain_b))
         signed = block_cls(message=block, signature=sig)
-        if not self._chaos_active():
+        from ..beacon_chain.chain import BlockPendingAvailability
+
+        try:
             node.chain.process_block(signed)
-        else:
-            try:
-                node.chain.process_block(signed)
-            except Exception:  # noqa: BLE001 — chaos realism: a proposer
-                # whose head/pool diverged under gossip loss builds a block
-                # its own chain rejects; a real network misses that slot
-                self.missed_proposals += 1
-                return
+        except BlockPendingAvailability:
+            pass  # parked: imports once the proposer's own columns land
+        except Exception:  # noqa: BLE001 — chaos realism: a proposer
+            # whose head/pool diverged under gossip loss builds a block
+            # its own chain rejects; a real network misses that slot
+            if not self._chaos_active():
+                raise
+            self.missed_proposals += 1
+            return
         node.publish_block(signed)
         self._msg_total += 1
+        if plan is not None and self.cell_ctx is not None:
+            self._publish_columns(node, signed, plan)
+
+    def _publish_columns(self, node, signed, plan) -> None:
+        """Build the proposal's column sidecars and fan them out. The
+        loopback bus excludes the publisher, so the proposer self-ingests
+        each column through the same verified gossip path; withheld
+        indices never reach the wire at all."""
+        from ..beacon_chain.data_columns import make_data_column_sidecars
+
+        blobs, withhold = plan
+        columns = make_data_column_sidecars(
+            node.chain.ns, signed, blobs, self.cell_ctx
+        )
+        for sc in columns:
+            if int(sc.index) in withhold:
+                continue
+            self._guarded(node.process_gossip_data_column, sc)
+            node.publish_data_column(sc)
+            self._msg_total += 1
+        # straggler repair + availability re-check on every live node
+        self.retry_columns(signed.message.tree_root())
 
     def _attest(self, slot: int) -> None:
         # per-node guard: one attester dying at its own barrier must not

@@ -46,15 +46,19 @@ def _e1(batch=4):
 
 @pytest.mark.kernel
 class TestCertifier:
+    @pytest.mark.slow
     def test_clean_tree_proves_every_callsite_both_backends(self):
         """The whole public op-graph surface certifies under BOTH conv
         backends (acceptance criterion). Batch 32 exercises the f64-walk
-        dispatch regime; the u64-walk regime is covered below."""
+        dispatch regime; the u64-walk regime is covered below. Slow lane:
+        the full sweep re-derives every obligation (~2.5 min); tier-1 keeps
+        the memoized five-pass CLI gate plus the per-module subset tests."""
         cert = bounds.certify(backends=("f64", "digits"), batches=(32,))
         bad = [r for r in cert["obligations"] if not r["ok"]]
         assert cert["ok"] and not bad, bad[:5]
         graphs = {r["graph"] for r in cert["obligations"]}
-        for mod in ("fq.", "tower.", "curve.", "h2c.", "pairing.", "pallas."):
+        for mod in ("fq.", "tower.", "curve.", "h2c.", "pairing.", "pallas.",
+                    "kzg."):
             assert any(mod in g for g in graphs), f"no obligations from {mod}*"
         for backend in ("f64@", "digits@"):
             assert any(g.startswith(backend) for g in graphs)
@@ -78,6 +82,22 @@ class TestCertifier:
             "pallas_reduce_value",
             "pallas_reduce_limb",
         } <= kinds
+
+    def test_kzg_graphs_certify_both_backends(self):
+        """Tier-1 sized: the PR-16 Fr limb graphs (kzg.fr_*) certify under
+        both conv backends — the all-graph sweep above rides the slow
+        lane."""
+        cert = bounds.certify(
+            backends=("f64", "digits"), batches=(4,), graphs=["kzg."]
+        )
+        bad = [r for r in cert["obligations"] if not r["ok"]]
+        assert cert["ok"] and not bad, bad[:5]
+        graphs = {r["graph"] for r in cert["obligations"]}
+        # fr_bits traces too but emits no obligations (pure bit split —
+        # no conv product or wide accumulation to bound)
+        for g in ("kzg.fr_mul", "kzg.fr_dot", "kzg.fr_weighted_sum",
+                  "kzg.fr_wide_reduce"):
+            assert any(g in name for name in graphs), f"no obligations from {g}"
 
     def test_u64_walk_regime_certifies(self, monkeypatch):
         """The u64 reduction walk is dead-by-default since

@@ -192,5 +192,220 @@ def test_column_gossip_ingest(ctx):
     transport.publish("a", Topic.DATA_COLUMN_SIDECAR, columns[3])
     root = columns[3].signed_block_header.message.tree_root()
     assert 3 in b.chain.data_column_cache[root]
-    # node without sampling enabled ignores the topic quietly
-    assert not hasattr(a.chain, "data_column_cache")
+    # node without sampling enabled ignores the topic quietly (the cache
+    # itself now always exists — created in chain init, not lazily)
+    assert a.chain.data_column_cache == {}
+
+
+# ---------------------------------------------------------------------------
+# Sidecar failure modes under BOTH KZG dispatch backends (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _deneb_spec():
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    return minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def sidecar_env(ctx):
+    from lighthouse_tpu.beacon_chain.data_columns import (
+        make_data_column_sidecars,
+    )
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.containers import for_preset
+
+    spec = _deneb_spec()
+    ns = for_preset("minimal")
+    h = StateHarness(spec, 16)
+    rng = np.random.default_rng(7)
+    blobs = [_blob(rng)]
+    block, _ = h.produce_block_with_blobs(1, blobs, ctx.kzg)
+    columns = make_data_column_sidecars(ns, block, blobs, ctx)
+    return ns, columns
+
+
+@pytest.fixture(params=["host", "device"])
+def kzg_dispatch(request):
+    """Both sides of the LIGHTHOUSE_KZG_BACKEND seam. The device side runs
+    tier-1 cheap: injected faults on both device rungs land every verify on
+    the cpu_oracle rung through the kzg_device ladder — same dispatch path,
+    same verdicts, no device graph compile."""
+    from lighthouse_tpu import resilience
+    from lighthouse_tpu.kzg import engine
+    from lighthouse_tpu.resilience.inject import injector
+    from lighthouse_tpu.resilience.supervisor import SupervisorConfig
+
+    prev = engine.get_kzg_backend()
+    if request.param == "host":
+        engine.set_kzg_backend("host")
+        yield request.param
+        engine.set_kzg_backend(prev)
+        return
+    sup = resilience.kzg_supervisor()
+    saved = sup.config
+    sup.config = SupervisorConfig(
+        deadline_s=5.0, max_retries=1, backoff_base_s=0.001,
+        backoff_max_s=0.005, promote_after=1, probe_every=1,
+        probation_s=60.0,
+    )
+    sup.reset()
+    engine.set_kzg_backend("device")
+    injector.install(
+        "stage=kzg.cell_batch_verify;mode=raise;every=1"
+        "|stage=kzg.cell_batch_verify/device_reduced;mode=raise;every=1"
+    )
+    yield request.param
+    injector.clear()
+    engine.set_kzg_backend(prev)
+    sup.config = saved
+    sup.reset()
+
+
+def test_sidecar_failure_modes_both_backends(ctx, sidecar_env, kzg_dispatch):
+    from lighthouse_tpu.beacon_chain.data_columns import (
+        DataColumnError,
+        verify_data_column_sidecar,
+    )
+
+    ns, columns = sidecar_env
+    enc = ns.DataColumnSidecar.encode(columns[2])
+
+    # the honest sidecar passes through this dispatch path first
+    verify_data_column_sidecar(ns, ns.DataColumnSidecar.decode(enc), ctx)
+
+    # wrong index: beyond the context's cell count
+    bad = ns.DataColumnSidecar.decode(enc)
+    bad.index = ctx.cells
+    with pytest.raises(DataColumnError, match="out of range"):
+        verify_data_column_sidecar(ns, bad, ctx)
+
+    # index/proof mismatch: column 2's cells presented under index 3
+    bad = ns.DataColumnSidecar.decode(enc)
+    bad.index = 3
+    with pytest.raises(DataColumnError, match="batch failed"):
+        verify_data_column_sidecar(ns, bad, ctx)
+
+    # length mismatch: an extra commitment with no matching cell/proof
+    bad = ns.DataColumnSidecar.decode(enc)
+    bad.kzg_commitments = list(bad.kzg_commitments) + [
+        bytes(bad.kzg_commitments[0])
+    ]
+    with pytest.raises(DataColumnError, match="length mismatch"):
+        verify_data_column_sidecar(ns, bad, ctx)
+
+    # empty column
+    bad = ns.DataColumnSidecar.decode(enc)
+    bad.column = []
+    bad.kzg_commitments = []
+    bad.kzg_proofs = []
+    with pytest.raises(DataColumnError, match="empty"):
+        verify_data_column_sidecar(ns, bad, ctx)
+
+    # nonzero bytes in the SSZ pad region beyond the test-scale cell
+    bad = ns.DataColumnSidecar.decode(enc)
+    cell = bytearray(bytes(bad.column[0]))
+    cell[ctx.bytes_per_cell + 5] = 1
+    bad.column[0] = bytes(cell)
+    with pytest.raises(DataColumnError, match="padding"):
+        verify_data_column_sidecar(ns, bad, ctx)
+
+    # bad inclusion proof
+    bad = ns.DataColumnSidecar.decode(enc)
+    bad.kzg_commitments_inclusion_proof[1] = b"\xff" * 32
+    with pytest.raises(DataColumnError, match="inclusion"):
+        verify_data_column_sidecar(ns, bad, ctx)
+
+    # bad proof batch: proof bytes from a different column
+    bad = ns.DataColumnSidecar.decode(enc)
+    bad.kzg_proofs[0] = bytes(columns[5].kzg_proofs[0])
+    with pytest.raises(DataColumnError, match="batch failed"):
+        verify_data_column_sidecar(ns, bad, ctx)
+
+
+def test_sidecar_fails_closed_when_ladder_exhausted(ctx, sidecar_env):
+    """Every rung of kzg_device faulted: an HONEST column must be rejected
+    (zero false-available) rather than waved through."""
+    from lighthouse_tpu import resilience
+    from lighthouse_tpu.beacon_chain.data_columns import (
+        DataColumnError,
+        verify_data_column_sidecar,
+    )
+    from lighthouse_tpu.kzg import engine
+    from lighthouse_tpu.resilience.inject import injector
+    from lighthouse_tpu.resilience.supervisor import SupervisorConfig
+
+    ns, columns = sidecar_env
+    sup = resilience.kzg_supervisor()
+    saved = sup.config
+    sup.config = SupervisorConfig(
+        deadline_s=5.0, max_retries=1, backoff_base_s=0.001,
+        backoff_max_s=0.005, promote_after=1, probe_every=1,
+        probation_s=60.0,
+    )
+    sup.reset()
+    prev = engine.get_kzg_backend()
+    engine.set_kzg_backend("device")
+    injector.install("stage=kzg.cell_batch_verify*;mode=raise;every=1")
+    try:
+        with pytest.raises(DataColumnError, match="batch failed"):
+            verify_data_column_sidecar(ns, columns[0], ctx)
+        snap = sup.snapshot()
+        assert snap["exhausted"] >= 1
+    finally:
+        injector.clear()
+        engine.set_kzg_backend(prev)
+        sup.config = saved
+        sup.reset()
+
+
+def test_data_column_cache_bounded_and_pruned(ctx, sidecar_env):
+    """Satellite (b): the cache exists from chain init (no lazy-create
+    race), is LRU-bounded to the DA checker's pending window, and drops
+    entries at or below the finalized horizon."""
+    from lighthouse_tpu.network import BeaconNodeService, LoopbackTransport
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    ns, columns = sidecar_env
+    spec = _deneb_spec()
+    h = StateHarness(spec, 16)
+    svc = BeaconNodeService(
+        "cachebound", spec, h.state.copy(), LoopbackTransport(),
+        slot_clock=ManualSlotClock(1),
+    )
+    chain = svc.chain
+    assert chain.data_column_cache == {}  # created in init, not lazily
+
+    enc = ns.DataColumnSidecar.encode(columns[0])
+    cap = chain.da_checker.MAX_PENDING
+
+    def _variant(slot):
+        sc = ns.DataColumnSidecar.decode(enc)
+        sc.signed_block_header.message.slot = slot
+        return sc
+
+    roots = [chain.put_data_column(_variant(100 + i)) for i in range(cap + 5)]
+    assert len(set(roots)) == cap + 5
+    assert len(chain.data_column_cache) == cap  # LRU bound holds
+    # oldest entries evicted, newest retained
+    assert roots[0] not in chain.data_column_cache
+    assert roots[-1] in chain.data_column_cache
+    assert chain.data_columns_for(roots[-1])  # snapshot sees the entry
+
+    # finalized-horizon prune: advance the finalized checkpoint past the
+    # cached slots, then insert one fresh column — everything at or below
+    # the horizon is swept
+    fin_epoch = (100 + cap + 5) // spec.preset.SLOTS_PER_EPOCH + 1
+    cp = chain.fork_choice.store.finalized_checkpoint
+    chain.fork_choice.store.finalized_checkpoint = (fin_epoch, cp[1])
+    try:
+        fresh_slot = spec.start_slot(fin_epoch) + 1
+        fresh = chain.put_data_column(_variant(fresh_slot))
+        assert list(chain.data_column_cache) == [fresh]
+    finally:
+        chain.fork_choice.store.finalized_checkpoint = cp

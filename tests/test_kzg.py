@@ -147,6 +147,25 @@ class TestMsm:
         got = msm(points, scalars, backend="tpu")
         assert got == expect
 
+    def test_backend_seam_aliases_agree(self):
+        """ONE dispatch seam: the KZG-engine backend names funnel into the
+        same two implementations as the bls backend names, byte-for-byte."""
+        rng = np.random.default_rng(8)
+        g = oc.g1_generator()
+        points = [oc.g1_mul(g, int(rng.integers(1, 1000))) for _ in range(8)]
+        scalars = [
+            int.from_bytes(rng.bytes(32), "big") % BLS_MODULUS for _ in range(8)
+        ]
+        expect = pippenger(points, scalars)
+        for alias in ("host", "oracle", "native", "pippenger"):
+            assert msm(points, scalars, backend=alias) == expect
+        # "device" is an alias for "tpu" — rides the jit cache the previous
+        # test already paid for
+        assert msm(points, scalars, backend="device") == msm(
+            points, scalars, backend="tpu"
+        )
+        assert msm(points, scalars, backend="device") == expect
+
 
 @pytest.mark.slow
 class TestMainnetBlob:

@@ -119,6 +119,13 @@ RUNGS.insert(1, bench._H2C_RUNG_SMALL)
 # integrity stamp + span-store mode, so a numpy-demoted run can't
 # masquerade as a device record.
 RUNGS.insert(5, bench._SLASHER_RUNG_SMALL)
+# PeerDAS cell-proof rung (ISSUE 16): the device-batched KZG engine —
+# every cell of a 6-blob block settled in ONE combined pairing check. Rides
+# early (its limb graph is small and compile-warm via .jax_cache); the
+# record embeds the engine's compile_probe so the one-pairing invariant is
+# pinned in the measurement, plus the resilience integrity stamp. Starts
+# only behind the bench-main flock marker check in main() like every rung.
+RUNGS.insert(3, bench._KZG_CELLS_RUNG_SMALL)
 RUNGS.append(bench._EPOCH_RUNG_FULL)
 RUNGS.append(bench._EPOCH_SHARDED_RUNG_FULL)
 RUNGS.append(bench._SLASHER_RUNG_FULL)
