@@ -1648,6 +1648,125 @@ def _inner_kzg_cells():
     )
 
 
+def _inner_light_clients():
+    """Light-client mass-service rung (ISSUE 17): a batch of heterogeneous
+    sync-committee update sessions at the MAINNET committee size (512)
+    folded into ONE combined pairing check on the device engine. Reports
+    ``light_clients_served_per_s`` with the per-session host loop — the
+    exact ``verify_light_client_update`` oracle, which re-decompresses
+    every participant pubkey per session — timed at the same workload as
+    the twin baseline. Session-for-session parity against that oracle is
+    asserted in-rung on a batch with tampered members, and the engine's
+    ``compile_probe`` record (one Miller product + one final exponentiation
+    per batch, proven at trace time) is embedded in the measurement."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    import jax
+
+    if fallback:
+        jax.config.update("jax_platforms", "cpu")
+
+    from lighthouse_tpu import bls
+    from lighthouse_tpu.light_client import engine
+    from lighthouse_tpu.light_client.verify import verify_light_client_update
+    from lighthouse_tpu.testing import StateHarness
+    from lighthouse_tpu.testing.lc_workload import (
+        fabricate_lc_sessions,
+        tamper_session,
+    )
+    from lighthouse_tpu.types.spec import mainnet_spec
+
+    bls.set_backend("native")
+    n_sessions = BATCH or int(os.environ.get("BENCH_LC_SESSIONS", "16"))
+    validators = int(os.environ.get("BENCH_LC_VALIDATORS", "64"))
+    iters = int(os.environ.get("BENCH_LC_ITERS", "5"))
+    platform = jax.devices()[0].platform
+
+    spec = mainnet_spec(altair_fork_epoch=0)
+    t0 = time.perf_counter()
+    harness = StateHarness(spec, validators)
+    sessions, gvr = fabricate_lc_sessions(harness, n_sessions, seed=0x11C)
+    committee_size = int(spec.preset.SYNC_COMMITTEE_SIZE)
+    print(
+        f"# fixture: {n_sessions} sessions x {committee_size}-key committee "
+        f"({time.perf_counter() - t0:.0f}s)",
+        flush=True,
+    )
+
+    engine.set_lc_backend("device")
+    eng = engine.get_engine(spec)
+    probe = eng.compile_probe(n_sessions)
+    t0 = time.perf_counter()
+    ok = eng.verify_batch(sessions, gvr)
+    print(
+        f"# warmup (compile) {time.perf_counter() - t0:.0f}s on {platform}",
+        flush=True,
+    )
+    assert ok, "honest session batch rejected — engine broken, no record"
+    tampered = list(sessions)
+    tampered[1] = tamper_session(sessions[1], "signature")
+    assert not eng.verify_batch(tampered, gvr), (
+        "tampered session batch accepted — engine broken, no record"
+    )
+    # session-for-session parity vs the host oracle on the mixed batch (the
+    # dispatch layer bisects the device verdicts down to per-session)
+    mixed = list(sessions)
+    mixed[1] = tamper_session(sessions[1], "signature")
+    mixed[3] = tamper_session(sessions[3], "header")
+    dev_verdicts = engine.verify_update_batch(spec, mixed, gvr)
+    host_verdicts = [
+        verify_light_client_update(spec, u, c, gvr) for u, c in mixed
+    ]
+    assert dev_verdicts == host_verdicts, (
+        f"device/host verdict mismatch: {dev_verdicts} vs {host_verdicts}"
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ok = eng.verify_batch(sessions, gvr)
+    dt = time.perf_counter() - t0
+    value = n_sessions * iters / dt if dt else 0.0
+
+    # host twin: the per-session oracle loop at the SAME workload (committee
+    # pubkey decompression repaid on every session — the cost the device
+    # cache amortizes away)
+    t0 = time.perf_counter()
+    host_ok = all(
+        verify_light_client_update(spec, u, c, gvr) for u, c in sessions
+    )
+    host_dt = time.perf_counter() - t0
+    assert host_ok, "host oracle rejected the honest batch"
+    host_value = n_sessions / host_dt if host_dt else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "light_clients_served_per_s",
+                "value": round(value, 2),
+                "unit": "sessions/s",
+                "vs_baseline": (
+                    round(value / host_value, 3) if host_value else None
+                ),
+                "platform": platform,
+                **_backend_stamp(),
+                "lc_backend": engine.get_lc_backend(),
+                "fallback": fallback,
+                "shape": {
+                    "sessions": n_sessions,
+                    "committee_size": committee_size,
+                    "validators": validators,
+                },
+                "ms_per_batch": round(dt / iters * 1e3, 3) if iters else None,
+                "host_loop_sessions_per_s": round(host_value, 2),
+                # the tentpole invariant, pinned inside the record: the whole
+                # batch settles in ONE combined pairing check of B+1 pairs
+                "compile_probe": probe,
+                "resilience": _resilience_summary(),
+            }
+        )
+    )
+
+
 # Shape ladder: (sets, keys, validators, batch, timeout_s). The first entry
 # is the mainnet shape (BASELINE.json config #4); smaller rungs bound a
 # pathological device compile (observed: the tunnel's server-side compile of
@@ -1724,6 +1843,12 @@ _PAIRING_RUNG_SMALL = (0, 0, 0, 8, 1350.0, "pairing")
 # engine's batch-graph compile on a CPU proxy; warm .jax_cache measures.
 _KZG_CELLS_RUNG_SMALL = (0, 0, 0, 6, 2700.0, "kzg_cells")
 
+# Light-client serving rung (ISSUE 17): `batch` is the session count per
+# dispatch at the mainnet committee size (512); validators / iters come
+# from BENCH_LC_* env. The 2700 s timeout bounds the batched pairing
+# graph's compile on a CPU proxy; warm .jax_cache measures.
+_LIGHT_CLIENTS_RUNG_SMALL = (0, 0, 0, 16, 2700.0, "light_clients")
+
 
 def git_head() -> str:
     """Current repo HEAD (short), best-effort. Shared with the hunter so
@@ -1755,6 +1880,8 @@ def _hunter_record(mode: str = "sets") -> dict | None:
         "h2c": "tpu_h2c_record.json",
         "pairing": "tpu_pairing_record.json",
         "slasher": "tpu_slasher_record.json",
+        "kzg_cells": "tpu_kzg_cells_record.json",
+        "light_clients": "tpu_light_clients_record.json",
     }.get(mode, "tpu_record.json")
     # the hunter keys its best-record files by the conv-backend stamp
     # (pallas / digits / f64 measure different kernels); resolve across all
@@ -1849,6 +1976,8 @@ def main():
         mode = "pairing"
     elif "--kzg-cells" in sys.argv:
         mode = "kzg_cells"
+    elif "--light-clients" in sys.argv:
+        mode = "light_clients"
     if "--inner" in sys.argv:
         inner_mode = os.environ.get("BENCH_MODE", mode)
         if inner_mode == "firehose":
@@ -1865,6 +1994,8 @@ def main():
             _inner_pairing()
         elif inner_mode == "kzg_cells":
             _inner_kzg_cells()
+        elif inner_mode == "light_clients":
+            _inner_light_clients()
         else:
             _inner()
         return
@@ -1942,6 +2073,10 @@ def _main_measure(mode: str) -> None:
         # batch = blobs per block; the fallback rung keeps the mainnet blob
         # count (the graph is the same program — only the compile is slower)
         ladder = [_KZG_CELLS_RUNG_SMALL[:5]]
+    elif mode == "light_clients":
+        # batch = sessions per dispatch at the mainnet committee size; the
+        # fallback rung keeps the shape (same program, slower compile)
+        ladder = [_LIGHT_CLIENTS_RUNG_SMALL[:5]]
     elif mode == "epoch":
         # (validators, timeout) → run_inner's (sets, keys, validators,
         # batch, timeout) plumbing; on a wedged tunnel only the CPU-sized
@@ -1990,6 +2125,7 @@ def _main_measure(mode: str) -> None:
         "pairing": "pairing_sets_per_s",
         "slasher": "slashable_checks_per_s",
         "kzg_cells": "kzg_cells_verified_per_s",
+        "light_clients": "light_clients_served_per_s",
     }.get(mode, "bls_attestation_sets_verified_per_s")
     print(
         json.dumps(
@@ -2002,6 +2138,7 @@ def _main_measure(mode: str) -> None:
                     "epoch_sharded": "validators/s",
                     "h2c": "points/s", "pairing": "sets/s",
                     "slasher": "checks/s", "kzg_cells": "cells/s",
+                    "light_clients": "sessions/s",
                 }.get(mode, "sets/s"),
                 "vs_baseline": 0.0,
                 "platform": platform,

@@ -150,6 +150,7 @@ def graph_registry(batch: int) -> list[tuple]:
     from ..ops.bls import curve, fq, h2c, pairing, pallas_kernels as pk, plans, tower
     from ..ops.bls_oracle.fields import BLS_X
     from ..ops.kzg import frops
+    from ..ops.lc import verify as lcv
 
     u64 = jnp.uint64
     B = (batch,)
@@ -305,6 +306,51 @@ def graph_registry(batch: int) -> list[tuple]:
         ("kzg.fr_wide_reduce",
          lambda t: frops.fr_wide_reduce(t, frops.R2_INT), (s(49),)),
         ("kzg.fr_bits", frops.fr_bits, (e1,)),
+        # ops/lc/verify.py — the light-client mass-service tier (ISSUE 17):
+        # B heterogeneous sync-committee update sessions settled in ONE
+        # shared-accumulator pairing check. The stages are certified
+        # separately (they are separate compile units at runtime) AND as
+        # the lc_batch_check composition the compile probe lowers; the
+        # masked committee aggregation (point_sum over the gathered cache),
+        # the fused groupcheck+scaling pass and the B+1-pair Miller product
+        # all record their obligations via fq._cert at trace time, under
+        # every conv backend the five-pass CLI sweeps. Cache rows use a
+        # small committee (C=8): the bound walk is per-lane, independent of
+        # the committee/period extents, so the mainnet C=512 shape proves
+        # the same obligations.
+        ("lc.h2c", lcv.lc_h2c, (e2, e2)),
+        ("lc.prep", lcv.lc_prep,
+         (
+             jax.ShapeDtypeStruct((4, 8, 3, 25), u64),       # pubkey cache
+             jax.ShapeDtypeStruct(B, jnp.int32),             # pidx
+             jax.ShapeDtypeStruct(B + (8,), jnp.bool_),      # bitfields
+             e1, e1,                                         # sig x limbs
+             sc,                                             # s_flag
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # sig_wf
+             sc,                                             # scalars
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # valid
+         )),
+        ("lc.pair", lcv.lc_pair,
+         (
+             s(1, 25), s(1, 25),                             # pk affine
+             jax.ShapeDtypeStruct((2, 25), u64),             # sig-sum x
+             jax.ShapeDtypeStruct((2, 25), u64),             # sig-sum y
+             e2, e2,                                         # msg affine
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # set_ok
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # valid
+         )),
+        ("lc.batch_check", lcv.lc_batch_check,
+         (
+             jax.ShapeDtypeStruct((4, 8, 3, 25), u64),       # pubkey cache
+             jax.ShapeDtypeStruct(B, jnp.int32),             # pidx
+             jax.ShapeDtypeStruct(B + (8,), jnp.bool_),      # bitfields
+             e2, e2,                                         # u0/u1
+             e1, e1,                                         # sig x limbs
+             sc,                                             # s_flag
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # sig_wf
+             sc,                                             # scalars
+             jax.ShapeDtypeStruct(B, jnp.bool_),             # valid
+         )),
         # slasher/kernels.py — the whole-registry surveillance sweep
         # (ISSUE 11): window roll + scatter + directional scans + candidate
         # flags over the span planes. Its obligations (u16 distance width,

@@ -1031,6 +1031,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/eth/v2/debug/beacon/states/(head|justified|finalized)$"), "debug_state"),
     ("GET", re.compile(r"^/eth/v2/beacon/blocks/(\w+|0x[0-9a-fA-F]{64})$"), "block"),
     ("GET", re.compile(r"^/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-fA-F]{64})$"), "lc_bootstrap"),
+    ("GET", re.compile(r"^/eth/v1/beacon/light_client/updates$"), "lc_updates"),
     ("GET", re.compile(r"^/eth/v1/beacon/light_client/optimistic_update$"), "lc_optimistic"),
     ("GET", re.compile(r"^/eth/v1/beacon/light_client/finality_update$"), "lc_finality"),
 ]
@@ -1231,6 +1232,13 @@ def _make_handler(api: BeaconApiServer):
                 if b is None:
                     raise ApiError(404, "bootstrap unavailable for root")
                 return _hex(type(b).encode(b))
+            if name == "lc_updates":
+                start = int(q.get("start_period", 0))
+                count = max(0, min(int(q.get("count", 1)), 128))
+                ups = api.chain.light_client_cache.updates_by_range(
+                    start, count
+                )
+                return [_hex(type(u).encode(u)) for u in ups]
             if name == "lc_optimistic":
                 u = api.chain.light_client_cache.latest_optimistic
                 if u is None:

@@ -11,16 +11,35 @@ REAL SSZ proofs generated from the state's field tree
 (ssz.merkle.merkle_branch_from_chunks) and verify against the spec
 generalized indices (current=54, next=55, finality root=105 for a 32-field
 state tree).
+
+The mass-service tier (ISSUE 17) adds ``engine`` — device-batched update
+verification (one combined pairing check per batch of heterogeneous
+sessions behind ``LIGHTHOUSE_LC_BACKEND``, failing CLOSED under the
+``lc_device`` resilience domain) — and ``update_store``, the
+period-indexed, spec-ranked ``LightClientUpdate`` archive behind
+``/eth/v1/beacon/light_client/updates`` and the LightClientUpdatesByRange
+Req/Resp protocol.
 """
 
+from .engine import (
+    get_lc_backend,
+    set_lc_backend,
+    verify_update_batch,
+)
 from .proofs import field_branch
 from .server_cache import LightClientServerCache
 from .types import light_client_types
+from .update_store import LightClientUpdateStore, is_better_update
 from .verify import verify_light_client_update
 
 __all__ = [
     "LightClientServerCache",
+    "LightClientUpdateStore",
     "field_branch",
+    "get_lc_backend",
+    "is_better_update",
     "light_client_types",
+    "set_lc_backend",
     "verify_light_client_update",
+    "verify_update_batch",
 ]

@@ -18,8 +18,15 @@ def state_tree_depth(state_cls) -> int:
     return (next_pow2(len(state_cls.FIELDS)) - 1).bit_length()
 
 
-@lru_cache(maxsize=None)
 def light_client_types(preset_name: str, fork: str = "altair"):
+    # normalize BEFORE the cache: ("minimal",) and ("minimal", "altair")
+    # must yield the SAME classes or isinstance checks (the wire codec's
+    # fork scan) silently fail across call sites
+    return _light_client_types(preset_name, fork)
+
+
+@lru_cache(maxsize=None)
+def _light_client_types(preset_name: str, fork: str):
     ns = for_preset(preset_name)
     depth = state_tree_depth(ns.state_types[fork])
     finality_depth = depth + 1  # + the Checkpoint container level

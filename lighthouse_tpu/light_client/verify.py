@@ -57,39 +57,10 @@ def verify_bootstrap(spec, bootstrap, trusted_block_root: bytes) -> bool:
     )
 
 
-def verify_light_client_update(
-    spec, update, sync_committee, genesis_validators_root: bytes,
-    finality_required: bool = False,
-) -> bool:
-    """Verify an optimistic/finality update against a trusted committee."""
-    agg = update.sync_aggregate
-    bits = np.asarray(agg.sync_committee_bits, dtype=bool)
-    if bits.sum() < spec.preset.MIN_SYNC_COMMITTEE_PARTICIPANTS:
-        return False
-    if finality_required or hasattr(update, "finality_branch"):
-        if hasattr(update, "finality_branch"):
-            depth, index = _gindex_depth_index(
-                _state_gindex(
-                    spec,
-                    int(update.attested_header.beacon.slot),
-                    ["finalized_checkpoint", "root"],
-                )
-            )
-            fin_root = type(update.finalized_header.beacon).hash_tree_root(
-                update.finalized_header.beacon
-            )
-            if not is_valid_merkle_branch(
-                fin_root,
-                list(update.finality_branch),
-                depth,
-                index,
-                bytes(update.attested_header.beacon.state_root),
-            ):
-                return False
-        elif finality_required:
-            return False
-    # sync aggregate: committee pubkeys at set bits sign the attested root
-    # with the sync domain of the epoch before signature_slot
+def sync_signing_root(spec, update, genesis_validators_root: bytes) -> bytes:
+    """The root the sync committee signs: the attested header root under
+    the sync domain of the epoch before ``signature_slot``. Shared by the
+    host oracle below and the device engine's marshalling."""
     prev_slot = max(int(update.signature_slot), 1) - 1
     fork_version = spec.fork_version(spec.fork_name_at_slot(prev_slot))
     domain = compute_domain(
@@ -98,13 +69,85 @@ def verify_light_client_update(
     attested_root = type(update.attested_header.beacon).hash_tree_root(
         update.attested_header.beacon
     )
-    root = SigningData(object_root=attested_root, domain=domain).tree_root()
-    keys = [
-        bls.PublicKey.from_bytes(bytes(sync_committee.pubkeys[i]))
-        for i, b in enumerate(bits)
-        if b
-    ]
-    sig = bls.Signature.from_bytes(bytes(agg.sync_committee_signature))
+    return SigningData(object_root=attested_root, domain=domain).tree_root()
+
+
+def precheck_update(spec, update, finality_required: bool = False) -> bool:
+    """Everything BUT the signature: participation floor + the merkle
+    branches present on the update (finality and, for full
+    ``LightClientUpdate`` objects, the next-sync-committee branch). The
+    device engine applies the same prechecks on the host before batching
+    signatures, so host/device verdicts agree session-for-session."""
+    agg = update.sync_aggregate
+    bits = np.asarray(agg.sync_committee_bits, dtype=bool)
+    if bits.sum() < spec.preset.MIN_SYNC_COMMITTEE_PARTICIPANTS:
+        return False
+    attested_slot = int(update.attested_header.beacon.slot)
+    if hasattr(update, "finality_branch"):
+        branch = [bytes(b) for b in update.finality_branch]
+        # spec: a full LightClientUpdate may carry an EMPTY finality proof
+        # (zeroed header + zero branch) when the signed period had no
+        # finalized ancestor yet — skip the branch check, it proves nothing
+        empty = int(update.finalized_header.beacon.slot) == 0 and all(
+            b == b"\x00" * 32 for b in branch
+        )
+        if empty:
+            if finality_required:
+                return False
+        else:
+            depth, index = _gindex_depth_index(
+                _state_gindex(
+                    spec, attested_slot, ["finalized_checkpoint", "root"]
+                )
+            )
+            fin_root = type(update.finalized_header.beacon).hash_tree_root(
+                update.finalized_header.beacon
+            )
+            if not is_valid_merkle_branch(
+                fin_root,
+                branch,
+                depth,
+                index,
+                bytes(update.attested_header.beacon.state_root),
+            ):
+                return False
+    elif finality_required:
+        return False
+    if hasattr(update, "next_sync_committee_branch"):
+        depth, index = _gindex_depth_index(
+            _state_gindex(spec, attested_slot, ["next_sync_committee"])
+        )
+        cls = type(update.next_sync_committee)
+        if not is_valid_merkle_branch(
+            cls.hash_tree_root(update.next_sync_committee),
+            list(update.next_sync_committee_branch),
+            depth,
+            index,
+            bytes(update.attested_header.beacon.state_root),
+        ):
+            return False
+    return True
+
+
+def verify_light_client_update(
+    spec, update, sync_committee, genesis_validators_root: bytes,
+    finality_required: bool = False,
+) -> bool:
+    """Verify an optimistic/finality update against a trusted committee."""
+    if not precheck_update(spec, update, finality_required):
+        return False
+    agg = update.sync_aggregate
+    bits = np.asarray(agg.sync_committee_bits, dtype=bool)
+    root = sync_signing_root(spec, update, genesis_validators_root)
+    try:
+        keys = [
+            bls.PublicKey.from_bytes(bytes(sync_committee.pubkeys[i]))
+            for i, b in enumerate(bits)
+            if b
+        ]
+        sig = bls.Signature.from_bytes(bytes(agg.sync_committee_signature))
+    except bls.BlsError:
+        return False  # malformed encoding is a verdict, not an error
     return bls.verify_signature_sets(
         [bls.SignatureSet.multiple_pubkeys(sig, keys, root)]
     )

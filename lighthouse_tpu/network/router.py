@@ -117,4 +117,13 @@ class Router:
             return self.svc.data_column_sidecars_by_range(
                 start_slot, count, columns
             )
+        if method == "light_client_bootstrap":
+            return self.svc.light_client_bootstrap(payload)
+        if method == "light_client_updates_by_range":
+            start_period, count = payload
+            return self.svc.light_client_updates_by_range(start_period, count)
+        if method == "light_client_optimistic_update":
+            return self.svc.light_client_optimistic_update()
+        if method == "light_client_finality_update":
+            return self.svc.light_client_finality_update()
         raise ValueError(f"unknown rpc method {method!r}")

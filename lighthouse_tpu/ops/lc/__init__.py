@@ -1,0 +1,20 @@
+"""Device light-client kernels: batched sync-committee update verification.
+
+The third cryptosystem consumer on the plan compiler (ISSUE 17), after the
+BLS firehose and the KZG cell engine. Everything rides ``ops/bls``: the
+25x16-bit limb layout and ``fq._conv_product`` seam (all three
+``LIGHTHOUSE_CONV_IMPL`` backends unchanged), ``h2c.map_to_g2`` for the
+signing roots, ``curve``/``g1``/``g2`` for the masked committee
+aggregation and the security prologue, and the shared-accumulator
+``pairing.miller_loop_product`` for the ONE combined pairing check per
+batch.
+
+* ``verify`` — the batched update-check graph: per-session participant
+  pubkey aggregation as a bitfield-masked G1 sum over a device-resident
+  per-period committee cache (heterogeneous periods gather different
+  cache rows in the SAME dispatch), signature decompression + subgroup
+  checks, Fiat-Shamir random scaling, and one B+1-pair Miller product +
+  one final exponentiation for the whole batch.
+"""
+
+from . import verify  # noqa: F401

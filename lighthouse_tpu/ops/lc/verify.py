@@ -1,0 +1,120 @@
+"""Batched light-client update verification: ONE pairing check per batch.
+
+Per session (spec ``process_light_client_update`` signature core): the
+participants' aggregated committee pubkey P_i signs the attested header's
+signing root m_i, so the check is e(P_i, H(m_i)) == e(G1, sig_i). Every
+session shares the G1 generator on the signature side, so B heterogeneous
+sessions (distinct periods, bitfields, attested roots) fold under
+Fiat-Shamir weights r_i into the blst ``verify_multiple_aggregate_
+signatures`` shape::
+
+    prod_i e(r_i * P_i, H(m_i)) * e(-G1, sum_i r_i * sig_i) == 1
+
+— B+1 pairs, one shared-accumulator Miller product, ONE final
+exponentiation. P_i is a bitfield-masked sum over a device-resident
+per-period committee pubkey cache ``[P, C, 3, 25]``: session i gathers
+row ``pidx[i]``, so a batch mixing sync-committee periods still runs as
+one dispatch.
+
+The security prologue mirrors ``bls/tpu_backend._set_prologue`` (blst's
+``sigs_groupcheck``): G2 subgroup check via psi(Q) == [x]Q fused with the
+random scaling into one windowed pass, infinity rejection for both the
+aggregate pubkey and the signature, well-formedness + on-curve flags from
+decompression, and an empty-bitfield reject. A session failing ANY check
+fails the whole batch (callers bisect, exactly like the attestation
+firehose).
+
+``PROBE`` counts trace-time pairing checks/pairs: jit tracing runs this
+module's Python once per compile, so a probe of exactly one
+``multi_pairing_is_one`` per batch is a property of the LOWERED graph,
+not of runtime logging (bench ``--light-clients`` embeds the record).
+
+Staged like the firehose hot path (``_gathered_kernel``'s three-stage
+design — one fused program compiled superlinearly, the r3 pathology):
+``lc_h2c`` / ``lc_prep`` / ``lc_pair`` are separate compile units and
+``lc_batch_check`` is their composition (what the bounds registry and the
+compile probe lower).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..bls import curve, fq, g1, g2, h2c, pairing
+from ..bls_oracle import curves as _oc
+from ..bls_oracle.fields import BLS_X
+
+# trace-time instrumentation (see module docstring)
+PROBE = {"pairing_checks": 0, "pairs": 0, "agg_sums": 0}
+
+_MINUS_G1 = _oc.g1_neg(_oc.g1_generator())
+_MG1_X = fq.from_int(_MINUS_G1[0])
+_MG1_Y = fq.from_int(_MINUS_G1[1])
+
+
+def lc_h2c(u0, u1):
+    """Stage 1: device hash-to-curve for the signing roots.
+
+    u0/u1 [B, 2, 25] hash_to_field residues (host SHA-256) -> affine
+    G2 message points (mx, my) [B, 2, 25] each."""
+    return g2.to_affine(h2c.map_to_g2(u0, u1))
+
+
+def lc_prep(cache, pidx, bits, sxc0, sxc1, s_flag, sig_wf, scalars, valid):
+    """Stage 2: committee gather + masked aggregation + security prologue.
+
+    cache  [P, C, 3, 25]  per-period committee pubkeys (projective); each
+                          row p holds period p's C decompressed keys
+    pidx   [B] int32      per-session cache row (heterogeneous periods)
+    bits   [B, C] bool    sync-committee participation bitfields
+    sxc0/sxc1 [B, 25]     raw signature x limbs (flags cleared)
+    s_flag [B] uint64     lex-sign bit; sig_wf [B] bool well-formed encoding
+    scalars [B] uint64    Fiat-Shamir weights; valid [B] bool real sessions
+
+    Returns affine (pkx, pky, sax, say) for the pairing stage plus the
+    per-session set_ok flags."""
+    sig, on_curve = g2.decompress(jnp.stack([sxc0, sxc1], axis=-2), s_flag)
+    pts = jnp.take(cache, pidx, axis=0)              # [B, C, 3, 25]
+    pk_agg = curve.point_sum(
+        1, jnp.moveaxis(pts, 1, 0), jnp.moveaxis(bits, 1, 0)
+    )
+    PROBE["agg_sums"] += 1
+    # blst sigs_groupcheck: psi(Q) == [x]Q (x < 0: [x]Q = -[|x|]Q), fused
+    # with the Fiat-Shamir scaling into one windowed pass over sig
+    accs = curve.scale_u64_with_fixed(2, sig, scalars, (-BLS_X,))
+    sig_scaled, abs_x_sig = accs[0], accs[1]
+    sig_grp = curve.point_eq(2, g2.psi(sig), curve.point_neg(2, abs_x_sig))
+    set_ok = ~valid | (sig_grp & ~g1.is_inf(pk_agg) & ~g2.is_inf(sig))
+    set_ok = set_ok & (~valid | (sig_wf & on_curve & jnp.any(bits, axis=1)))
+    pk_scaled = g1.scale_u64(pk_agg, scalars)
+    sig_sum = g2.psum(sig_scaled, valid)
+    pkx, pky = g1.to_affine(pk_scaled)
+    sax, say = g2.to_affine(sig_sum)
+    return pkx, pky, sax, say, set_ok
+
+
+def lc_pair(pkx, pky, sax, say, mxa, mya, set_ok, valid):
+    """Stage 3: B+1-pair Miller product + ONE final exponentiation +
+    verdict. The -G1 generator pairs with the scaled signature sum."""
+    b = valid.shape[0]
+    px = jnp.concatenate([pkx[:, 0, :], _MG1_X[None]], axis=0)
+    py = jnp.concatenate([pky[:, 0, :], _MG1_Y[None]], axis=0)
+    qx = jnp.concatenate([mxa, sax[None]], axis=0)
+    qy = jnp.concatenate([mya, say[None]], axis=0)
+    pair_valid = jnp.concatenate([valid, jnp.ones((1,), dtype=bool)])
+    PROBE["pairing_checks"] += 1
+    PROBE["pairs"] += b + 1
+    ok = pairing.multi_pairing_is_one(px, py, qx, qy, pair_valid)
+    return ok & jnp.all(set_ok) & jnp.any(valid)
+
+
+def lc_batch_check(cache, pidx, bits, u0, u1, sxc0, sxc1, s_flag, sig_wf,
+                   scalars, valid):
+    """The full batched update-check graph (stage composition): scalar
+    bool — the WHOLE batch of sessions verifies. Padded rows carry
+    valid=False and contribute the identity everywhere."""
+    mxa, mya = lc_h2c(u0, u1)
+    pkx, pky, sax, say, set_ok = lc_prep(
+        cache, pidx, bits, sxc0, sxc1, s_flag, sig_wf, scalars, valid
+    )
+    return lc_pair(pkx, pky, sax, say, mxa, mya, set_ok, valid)

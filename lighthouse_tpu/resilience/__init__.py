@@ -32,6 +32,10 @@ Canonical fault domains:
   ``kzg.cell_batch_verify`` with rungs ``device_full`` / ``device_reduced``
   / ``cpu_oracle``). Data availability fails CLOSED: a fully faulted
   ladder returns "not verified", never "available".
+* ``lc_supervisor()`` — the device-batched light-client update engine
+  (``light_client/engine.py``; injection stage ``lc.batch_verify`` with
+  the same three rungs). Fails CLOSED: a faulted ladder never reports a
+  light-client session verified.
 """
 
 from __future__ import annotations
@@ -73,6 +77,7 @@ BLS_DOMAIN = "bls_device"
 EPOCH_DOMAIN = "epoch_device"
 SLASHER_DOMAIN = "slasher_device"
 KZG_DOMAIN = "kzg_device"
+LC_DOMAIN = "lc_device"
 
 
 def bls_supervisor() -> BackendSupervisor:
@@ -99,6 +104,16 @@ def kzg_supervisor() -> BackendSupervisor:
     ANY rung is treated as unverified — the availability checker never
     marks a block available off a faulted ladder (fail closed)."""
     return get_supervisor(KZG_DOMAIN)
+
+
+def lc_supervisor() -> BackendSupervisor:
+    """The fault domain guarding device-batched light-client update
+    verification (``light_client/engine.py``; injection stage
+    ``lc.batch_verify`` with rungs ``device_full`` / ``device_reduced`` /
+    ``cpu_oracle``). Fails CLOSED: a session that cannot be verified on
+    ANY rung is reported unverified — a faulted ladder never reports a
+    light-client session verified."""
+    return get_supervisor(LC_DOMAIN)
 
 
 def health_snapshot() -> dict:

@@ -31,6 +31,7 @@ class DBColumn(enum.Enum):
     ColdState = b"cst"
     ColdStateDiff = b"cdf"
     Metadata = b"met"
+    LightClientUpdate = b"lcu"
     # slasher (slasher/src/database.rs database table names)
     SlasherTargets = b"stg"
     SlasherAttesterRecords = b"sar"

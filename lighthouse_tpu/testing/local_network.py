@@ -48,11 +48,17 @@ from .harness import StateHarness
 class LocalNetwork:
     def __init__(self, spec: ChainSpec, n_nodes: int, n_validators: int,
                  transport: str = "loopback", slasher: bool = False,
-                 datadir: str | None = None):
+                 datadir: str | None = None, sync_committee: bool = False):
         assert n_validators % n_nodes == 0
         self.spec = spec
         self.mode = transport
         self.slasher_enabled = slasher
+        # opt-in sync-committee duties (ISSUE 17): each slot every node's
+        # owned committee members sign the head root over gossip, so altair+
+        # blocks carry REAL sync aggregates and the light-client server
+        # caches produce updates. Off by default: it adds one aggregate
+        # pairing per imported block to every scenario that doesn't need it.
+        self.sync_committee = sync_committee
         # per-node datadirs (loopback mode): each node persists into its
         # own WAL-backed hot/cold store, making restart_node(from_disk=True)
         # — and the crash-point sweep killing nodes at persistence barriers
@@ -542,6 +548,53 @@ class LocalNetwork:
                 node.publish_attestation(att)
                 self._msg_total += 1
 
+    def _sync_sign(self, slot: int) -> None:
+        # per-node guard, like _attest: one signer dying at its own barrier
+        # must not cost the other nodes their sync messages for the slot
+        for i, (node, owned) in enumerate(zip(self.nodes, self.owned)):
+            if i in self.dead:
+                continue
+            self._guarded(self._sync_sign_node, node, owned, slot)
+
+    def _sync_sign_node(self, node, owned, slot: int) -> None:
+        """Sync-committee duties for ``node``'s owned validators: one
+        SyncCommitteeMessage per owned committee member over the node's own
+        head root, self-ingested (the loopback bus excludes the publisher)
+        and published. The NEXT slot's proposer pools them into its block's
+        sync aggregate (``produce_block_on_state`` reads slot-1)."""
+        from ..types.helpers import sync_committee_signing_root
+
+        state = node.chain.head.state
+        if not hasattr(state, "current_sync_committee"):
+            return  # pre-altair: no sync committees yet
+        head_root = node.chain.head.root
+        root = sync_committee_signing_root(self.spec, state, slot, head_root)
+        pk_to_idx = {
+            bytes(v.pubkey): i for i, v in enumerate(state.validators)
+        }
+        msgs, seen = [], set()
+        for pk in state.current_sync_committee.pubkeys:
+            v = pk_to_idx[bytes(pk)]
+            # one message per validator: the pool expands every committee
+            # position a duplicated member occupies from the single message
+            if v not in owned or v in seen:
+                continue
+            seen.add(v)
+            msgs.append(
+                node.chain.ns.SyncCommitteeMessage(
+                    slot=slot,
+                    beacon_block_root=head_root,
+                    validator_index=v,
+                    signature=self.harness._sign(v, root),
+                )
+            )
+        if not msgs:
+            return
+        node.process_gossip_sync_message_batch(msgs)
+        for m in msgs:
+            node.publish_sync_message(m)
+            self._msg_total += 1
+
     # -- crash-point attribution (ISSUE 12) --------------------------------
 
     def _on_injected_crash(self, exc) -> int:
@@ -583,6 +636,9 @@ class LocalNetwork:
         self.clock.set_slot(slot)
         self._guarded(self._propose, slot)
         self.settle()
+        if self.sync_committee:
+            self._sync_sign(slot)  # guards per node internally
+            self.settle()
         self._attest(slot)  # guards per node internally
         self.settle()
         if self.slasher_enabled:

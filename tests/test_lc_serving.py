@@ -1,0 +1,409 @@
+"""Light-client serving tier (ISSUE 17): the period-indexed update store's
+spec ``is_better_update`` ranking + single-frame persistence, the wire codec
+for the four LightClient Req/Resp methods, the server cache's recency guard,
+and the multi-node period-boundary scenario — two nodes cross a
+sync-committee rollover under churn (crash/restart + seeded gossip loss), a
+light client follows over the four RPC methods, and the collected sessions
+verify through ``verify_update_batch`` with injected ``lc_device`` faults
+producing ZERO false-verified sessions."""
+
+import dataclasses
+import struct
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import bls, resilience
+from lighthouse_tpu.light_client import engine
+from lighthouse_tpu.light_client.server_cache import LightClientServerCache
+from lighthouse_tpu.light_client.types import light_client_types
+from lighthouse_tpu.light_client.update_store import (
+    LightClientUpdateStore,
+    is_better_update,
+    sync_committee_period,
+)
+from lighthouse_tpu.light_client.verify import verify_bootstrap
+from lighthouse_tpu.network.codec import MessageCodec
+from lighthouse_tpu.resilience import inject
+from lighthouse_tpu.resilience.supervisor import SupervisorConfig
+from lighthouse_tpu.store.kv import DBColumn, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.testing.local_network import LocalNetwork
+from lighthouse_tpu.types.spec import minimal_spec
+
+LC = light_client_types("minimal")
+SPEC = minimal_spec(altair_fork_epoch=0)
+C = int(SPEC.preset.SYNC_COMMITTEE_SIZE)
+
+injector = inject.injector
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native_backend():
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    yield
+    bls.set_backend(prev)
+
+
+@pytest.fixture
+def lc_sup():
+    sup = resilience.lc_supervisor()
+    saved = sup.config
+    sup.config = SupervisorConfig(
+        deadline_s=5.0, max_retries=1, backoff_base_s=0.001,
+        backoff_max_s=0.005, promote_after=1, probe_every=1,
+        probation_s=0.05,
+    )
+    sup.reset()
+    yield sup
+    injector.clear()
+    sup.config = saved
+    sup.reset()
+
+
+def mk_update(active, att_slot=0, sig_slot=1, committee=False, fin_slot=None):
+    """Synthetic update exercising exactly the fields the ranking reads."""
+    u = LC.LightClientUpdate(signature_slot=sig_slot)
+    u.attested_header.beacon.slot = att_slot
+    bits = np.zeros(C, dtype=bool)
+    bits[:active] = True
+    u.sync_aggregate.sync_committee_bits = bits
+    if committee:
+        u.next_sync_committee_branch = [b"\x11" * 32] * len(
+            u.next_sync_committee_branch
+        )
+    if fin_slot is not None:
+        u.finality_branch = [b"\x22" * 32] * len(u.finality_branch)
+        u.finalized_header.beacon.slot = fin_slot
+    return u
+
+
+# -- the spec is_better_update total order -----------------------------------------
+
+
+class TestIsBetterUpdate:
+    def test_supermajority_dominates_participation(self):
+        # 22/32 crosses the 2/3 supermajority line on the minimal preset
+        assert is_better_update(SPEC, mk_update(22), mk_update(21))
+        assert not is_better_update(SPEC, mk_update(21), mk_update(22))
+        # below the line, raw participation decides
+        assert is_better_update(SPEC, mk_update(10), mk_update(5))
+        assert not is_better_update(SPEC, mk_update(5), mk_update(10))
+
+    def test_relevant_sync_committee_beats_bare(self):
+        rel = mk_update(25, att_slot=1, sig_slot=2, committee=True)
+        bare = mk_update(25, att_slot=1, sig_slot=2)
+        assert is_better_update(SPEC, rel, bare)
+        assert not is_better_update(SPEC, bare, rel)
+        # a populated branch whose attested header sits in a DIFFERENT
+        # period than the signature slot is not a relevant committee update
+        slots_per_period = (
+            SPEC.preset.SLOTS_PER_EPOCH
+            * SPEC.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        straddle = mk_update(
+            25, att_slot=slots_per_period - 1, sig_slot=slots_per_period,
+            committee=True,
+        )
+        assert sync_committee_period(
+            SPEC, straddle.attested_header.beacon.slot
+        ) != sync_committee_period(SPEC, straddle.signature_slot)
+        assert not is_better_update(SPEC, straddle, rel)
+
+    def test_finality_and_committee_finality(self):
+        fin = mk_update(25, att_slot=1, sig_slot=2, committee=True, fin_slot=0)
+        nofin = mk_update(25, att_slot=1, sig_slot=2, committee=True)
+        assert is_better_update(SPEC, fin, nofin)
+        assert not is_better_update(SPEC, nofin, fin)
+        # finalized header in the attested period beats one a period back
+        slots_per_period = (
+            SPEC.preset.SLOTS_PER_EPOCH
+            * SPEC.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        att = slots_per_period + 6
+        same = mk_update(25, att_slot=att, sig_slot=att + 1, committee=True,
+                         fin_slot=slots_per_period + 1)
+        back = mk_update(25, att_slot=att, sig_slot=att + 1, committee=True,
+                         fin_slot=3)
+        assert is_better_update(SPEC, same, back)
+        assert not is_better_update(SPEC, back, same)
+
+    def test_tie_breakers(self):
+        a = mk_update(25, att_slot=5, sig_slot=6, committee=True)
+        b = mk_update(24, att_slot=5, sig_slot=6, committee=True)
+        assert is_better_update(SPEC, a, b)          # more participation
+        older = mk_update(25, att_slot=4, sig_slot=6, committee=True)
+        assert is_better_update(SPEC, older, a)      # older attested slot
+        sooner = mk_update(25, att_slot=5, sig_slot=6, committee=True)
+        later = mk_update(25, att_slot=5, sig_slot=7, committee=True)
+        assert is_better_update(SPEC, sooner, later)  # older signature slot
+        assert not is_better_update(SPEC, later, sooner)
+
+
+# -- period archive persistence ----------------------------------------------------
+
+
+class TestUpdateStore:
+    def test_consider_ranks_and_serves_ranges(self):
+        store = LightClientUpdateStore(SPEC)
+        assert store.consider(mk_update(10, att_slot=1, sig_slot=2))
+        # a worse update for the same period is rejected
+        assert not store.consider(mk_update(5, att_slot=1, sig_slot=2))
+        assert store.consider(mk_update(25, att_slot=3, sig_slot=4))
+        slots_per_period = (
+            SPEC.preset.SLOTS_PER_EPOCH
+            * SPEC.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        att = 2 * slots_per_period + 1  # period 2: period 1 stays empty
+        assert store.consider(mk_update(25, att_slot=att, sig_slot=att + 1))
+        assert store.known_periods() == [0, 2]
+        got = store.get_updates(0, 4)  # the period-1 hole is skipped
+        assert [int(u.attested_header.beacon.slot) for u in got] == [3, att]
+        assert store.get_updates(5, 3) == []
+
+    def test_persist_restore_roundtrip(self):
+        kv = MemoryStore()
+        store = LightClientUpdateStore(SPEC, kv)
+        u0 = mk_update(25, att_slot=3, sig_slot=4, committee=True)
+        assert store.consider(u0)
+        # one row per period in the column, keyed by 8-byte BE period
+        rows = list(kv.iter_column(DBColumn.LightClientUpdate))
+        assert [k for k, _ in rows] == [struct.pack(">Q", 0)]
+        # a rejected candidate must not overwrite the persisted winner
+        assert not store.consider(mk_update(10, att_slot=3, sig_slot=4))
+        restored = LightClientUpdateStore(SPEC, kv)
+        assert restored.known_periods() == [0]
+        assert restored.best(0).serialize() == u0.serialize()
+
+    def test_restore_skips_corrupt_rows(self):
+        kv = MemoryStore()
+        store = LightClientUpdateStore(SPEC, kv)
+        store.consider(mk_update(25, att_slot=3, sig_slot=4))
+        kv.put(DBColumn.LightClientUpdate, struct.pack(">Q", 7), b"\x01junk")
+        kv.put(DBColumn.LightClientUpdate, b"short", b"\x01")
+        restored = LightClientUpdateStore(SPEC, kv)
+        assert restored.known_periods() == [0]
+
+
+# -- wire codec for the four Req/Resp methods --------------------------------------
+
+
+class TestLightClientCodec:
+    def test_request_roundtrip(self):
+        codec = MessageCodec(SPEC)
+        root = bytes(range(32))
+        raw = codec.encode_request("light_client_bootstrap", root)
+        assert codec.decode_request("light_client_bootstrap", raw) == root
+        raw = codec.encode_request("light_client_updates_by_range", (3, 7))
+        assert codec.decode_request(
+            "light_client_updates_by_range", raw
+        ) == (3, 7)
+        for m in (
+            "light_client_optimistic_update", "light_client_finality_update"
+        ):
+            assert codec.decode_request(m, codec.encode_request(m, None)) is None
+
+    def test_response_roundtrip(self):
+        codec = MessageCodec(SPEC)
+        ups = [
+            mk_update(25, att_slot=3, sig_slot=4, committee=True),
+            mk_update(30, att_slot=70, sig_slot=71, fin_slot=65),
+        ]
+        raw = codec.encode_response("light_client_updates_by_range", ups)
+        got = codec.decode_response("light_client_updates_by_range", raw)
+        assert [u.serialize() for u in got] == [u.serialize() for u in ups]
+        boot = LC.LightClientBootstrap()
+        boot.header.beacon.slot = 9
+        raw = codec.encode_response("light_client_bootstrap", boot)
+        got = codec.decode_response("light_client_bootstrap", raw)
+        assert got.serialize() == boot.serialize()
+        opt = LC.LightClientOptimisticUpdate(signature_slot=5)
+        raw = codec.encode_response("light_client_optimistic_update", opt)
+        got = codec.decode_response("light_client_optimistic_update", raw)
+        assert got.serialize() == opt.serialize()
+        # a node holding nothing answers empty -> None (and an empty range)
+        for m in (
+            "light_client_bootstrap",
+            "light_client_optimistic_update",
+            "light_client_finality_update",
+        ):
+            assert codec.decode_response(m, codec.encode_response(m, None)) is None
+        raw = codec.encode_response("light_client_updates_by_range", [])
+        assert codec.decode_response("light_client_updates_by_range", raw) == []
+
+
+# -- server cache recency guard ----------------------------------------------------
+
+
+class _FakeChain:
+    """The minimal chain surface the server cache reads: spec + read-through
+    block/state lookups + the observer seam (no store, no event bus)."""
+
+    def __init__(self, spec, blocks, states, genesis_root):
+        self.spec = spec
+        self.block_observers = []
+        self.genesis_block_root = genesis_root
+        self._b = blocks
+        self._s = states
+
+    def get_signed_block(self, root):
+        return self._b.get(bytes(root))
+
+    def state_by_root(self, root):
+        return self._s.get(bytes(root))
+
+
+def _child_block(harness, parent_root, slot, participation):
+    """Synthetic altair child carrying a sync aggregate with the given
+    participation — the cache ranks imports, it does not verify them."""
+    ns = harness.ns
+    fork = harness.spec.fork_name_at_slot(slot)
+    body_cls = ns.body_types[fork]
+    block_cls = ns.block_types[fork]
+    bits = np.zeros(C, dtype=bool)
+    bits[:participation] = True
+    body = body_cls(randao_reveal=b"\x00" * 96)
+    body.sync_aggregate = ns.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=b"\x00" * 96
+    )
+    inner = dict(block_cls.FIELDS)["message"](
+        slot=slot, proposer_index=0, parent_root=parent_root,
+        state_root=bytes([slot]) * 32, body=body,
+    )
+    return block_cls(message=inner, signature=b"\x00" * 96)
+
+
+class TestRecencyGuard:
+    @pytest.fixture(scope="class")
+    def attested(self):
+        harness = StateHarness(SPEC, 16)
+        signed = harness.produce_block(1)
+        harness.apply_block(signed)
+        root = signed.message.tree_root()
+        return harness, signed, root, harness.state.copy()
+
+    def test_same_slot_better_participation_replaces(self, attested):
+        harness, signed, root, state = attested
+        chain = _FakeChain(SPEC, {root: signed}, {root: state}, b"\x00" * 32)
+        cache = LightClientServerCache(chain)
+        cache.on_imported_block(_child_block(harness, root, 2, 3))
+        assert int(cache.latest_optimistic.signature_slot) == 2
+        # same slot, FEWER participants: the served update must not regress
+        cache.on_imported_block(_child_block(harness, root, 2, 2))
+        bits = np.asarray(
+            cache.latest_optimistic.sync_aggregate.sync_committee_bits
+        )
+        assert int(bits.sum()) == 3
+        # same slot, MORE participants: strictly better proof, replaces
+        cache.on_imported_block(_child_block(harness, root, 2, 5))
+        bits = np.asarray(
+            cache.latest_optimistic.sync_aggregate.sync_committee_bits
+        )
+        assert int(bits.sum()) == 5
+
+    def test_late_older_import_never_regresses(self, attested):
+        harness, signed, root, state = attested
+        chain = _FakeChain(SPEC, {root: signed}, {root: state}, b"\x00" * 32)
+        cache = LightClientServerCache(chain)
+        cache.on_imported_block(_child_block(harness, root, 3, 4))
+        # a late import of an OLDER slot, even fully participated, is stale
+        cache.on_imported_block(_child_block(harness, root, 2, C))
+        assert int(cache.latest_optimistic.signature_slot) == 3
+        # the rollover product landed in the period archive with a REAL
+        # next-committee branch
+        best = cache.update_store.best(0)
+        assert best is not None
+        assert any(
+            bytes(b) != b"\x00" * 32 for b in best.next_sync_committee_branch
+        )
+
+
+# -- the multi-node period-boundary scenario ---------------------------------------
+
+
+class TestPeriodBoundary:
+    def test_rollover_under_churn_with_injected_device_faults(self, lc_sup):
+        """Two nodes cross a sync-committee rollover (2-epoch periods -> 16
+        slots) with one node crash/restarted mid-period and seeded gossip
+        loss. A light client bootstraps from genesis over RPC, walks
+        UpdatesByRange across the boundary advancing its committee, and the
+        sessions verify through verify_update_batch — injected lc_device
+        faults demote to the oracle with verdicts intact, and a fully
+        faulted ladder reports ZERO false-verified sessions."""
+        spec = dataclasses.replace(
+            SPEC,
+            preset=dataclasses.replace(
+                SPEC.preset, EPOCHS_PER_SYNC_COMMITTEE_PERIOD=2
+            ),
+        )
+        net = LocalNetwork(spec, 2, 16, sync_committee=True)
+        net.transport.set_gossip_loss(0.05, seed=3)
+        try:
+            net.run_until(7)
+            net.crash_node(1)
+            net.run_until(11, start=8)
+            net.restart_node(1)
+            net.run_until(20, start=12)
+            assert net.heads_agree()
+
+            req = net.transport.request
+            gvr = bytes(
+                net.nodes[0].chain.genesis_state.genesis_validators_root
+            )
+            genesis_root = net.nodes[0].chain.genesis_block_root
+            # both nodes — including the restarted one, whose cache refilled
+            # from sync imports — serve updates on both sides of the boundary
+            for peer in ("node_0", "node_1"):
+                periods = req("client", peer, "light_client_updates_by_range",
+                              (0, 4))
+                assert [
+                    sync_committee_period(spec, int(u.signature_slot))
+                    for u in periods
+                ] == [0, 1]
+
+            boot = req("client", "node_0", "light_client_bootstrap",
+                       genesis_root)
+            assert verify_bootstrap(spec, boot, genesis_root)
+            committee = boot.current_sync_committee
+            sessions = []
+            for u in req("client", "node_0",
+                         "light_client_updates_by_range", (0, 4)):
+                sessions.append((u, committee))
+                committee = u.next_sync_committee  # advance at the boundary
+            opt = req("client", "node_0",
+                      "light_client_optimistic_update", None)
+            assert opt is not None
+            sessions.append((opt, committee))
+
+            prev = engine.get_lc_backend()
+            engine.set_lc_backend("host")
+            try:
+                want = engine.verify_update_batch(spec, sessions, gvr)
+            finally:
+                engine.set_lc_backend(prev)
+            assert want == [True] * len(sessions)
+
+            engine.set_lc_backend("device")
+            try:
+                # device rungs faulted: demotes to cpu_oracle, verdicts hold
+                injector.install(
+                    "stage=lc.batch_verify;mode=raise;every=1|"
+                    "stage=lc.batch_verify/device_reduced;mode=raise;every=1"
+                )
+                assert engine.verify_update_batch(spec, sessions, gvr) == want
+                snap = lc_sup.snapshot()
+                assert snap["demotions"] >= 1, snap
+                # the whole ladder faulted: every session comes back
+                # UNVERIFIED — zero false-verified under total device loss
+                lc_sup.reset()
+                injector.install("stage=lc.batch_verify*;mode=raise;every=1")
+                assert engine.verify_update_batch(spec, sessions, gvr) == [
+                    False
+                ] * len(sessions)
+                assert lc_sup.snapshot()["exhausted"] >= 1
+            finally:
+                injector.clear()
+                engine.set_lc_backend(prev)
+        finally:
+            net.stop()

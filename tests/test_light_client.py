@@ -131,5 +131,24 @@ def test_light_client_follows_chain():
         hdr = bad.attested_header.beacon
         hdr.proposer_index = int(hdr.proposer_index) + 1
         assert not verify_light_client_update(spec, bad, committee, gvr)
+
+        # pre-finalization-horizon bootstrap (ISSUE 17 regression): after
+        # four epochs the migrator has pruned early canonical blocks and
+        # states from the hot maps, which used to make bootstrap() return
+        # None for any pre-horizon trusted root — exactly the roots real
+        # light clients anchor on. Serving must read through to the store.
+        fin_epoch, fin_root = chain.fork_choice.store.finalized_checkpoint
+        assert int(fin_epoch) >= 2  # the migration actually ran
+        root = bytes(fin_root)
+        while True:  # walk to the earliest non-genesis canonical block
+            sb = chain.get_signed_block(root)
+            parent = bytes(sb.message.parent_root)
+            if parent == chain.genesis_block_root:
+                break
+            root = parent
+        assert root not in chain._blocks, "expected a migrated hot block"
+        boot3 = cache.bootstrap(root)
+        assert boot3 is not None
+        assert verify_bootstrap(spec, boot3, root)
     finally:
         client.stop()

@@ -63,6 +63,7 @@ def main():
     only = set(args[2:])
 
     from lighthouse_tpu.ops.bls import curve, g1, g2, h2c, pairing
+    from lighthouse_tpu.ops.lc import verify as lcv
     from lighthouse_tpu.bls import tpu_backend as tb
     from lighthouse_tpu.bls.serde import raw_to_mont
 
@@ -123,6 +124,43 @@ def main():
             jnp.ones((n + 1, 25), dtype=jnp.uint64),
             jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
             jnp.ones((n + 1, 2, 25), dtype=jnp.uint64),
+        )
+    if want("lc"):
+        # light-client batch-verify stages (ISSUE 17): n sessions over a
+        # k-key committee, 4 cache periods (the engine's P_pad floor). The
+        # composed graph's trace-time PROBE counters pin the tentpole
+        # structure — ONE pairing check of n+1 pairs, ONE masked committee
+        # aggregation — in the same record as the lowering sizes.
+        lcache = jnp.ones((4, k, 3, 25), dtype=jnp.uint64)
+        pidx = jnp.zeros((n,), dtype=jnp.int32)
+        lbits = jnp.ones((n, k), dtype=bool)
+        probe("lc.h2c", lcv.lc_h2c, u, u)
+        probe(
+            "lc.prep", lcv.lc_prep,
+            lcache, pidx, lbits, x25, x25, scalars, valid, scalars, valid,
+        )
+        probe(
+            "lc.pair", lcv.lc_pair,
+            jnp.ones((n, 1, 25), dtype=jnp.uint64),
+            jnp.ones((n, 1, 25), dtype=jnp.uint64),
+            jnp.ones((2, 25), dtype=jnp.uint64),
+            jnp.ones((2, 25), dtype=jnp.uint64),
+            u, u, valid, valid,
+        )
+        before = dict(lcv.PROBE)
+        probe(
+            "lc.batch_check", lcv.lc_batch_check,
+            lcache, pidx, lbits, u, u, x25, x25, scalars, valid, scalars,
+            valid,
+        )
+        _RESULTS["lc.batch_check"].update(
+            pairing_checks_per_batch_trace=(
+                lcv.PROBE["pairing_checks"] - before["pairing_checks"]
+            ),
+            pairs_per_check=lcv.PROBE["pairs"] - before["pairs"],
+            agg_sums_per_batch_trace=(
+                lcv.PROBE["agg_sums"] - before["agg_sums"]
+            ),
         )
     if want("finalexp"):
         probe(

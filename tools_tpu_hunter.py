@@ -68,6 +68,8 @@ RECORD_H2C = os.path.join(CACHE, "tpu_h2c_record.json")
 RECORD_PAIRING = os.path.join(CACHE, "tpu_pairing_record.json")
 RECORD_SLASHER = os.path.join(CACHE, "tpu_slasher_record.json")
 RECORD_SLASHER_SHARDED = os.path.join(CACHE, "tpu_slasher_sharded_record.json")
+RECORD_KZG_CELLS = os.path.join(CACHE, "tpu_kzg_cells_record.json")
+RECORD_LIGHT_CLIENTS = os.path.join(CACHE, "tpu_light_clients_record.json")
 RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
@@ -126,6 +128,13 @@ RUNGS.insert(5, bench._SLASHER_RUNG_SMALL)
 # pinned in the measurement, plus the resilience integrity stamp. Starts
 # only behind the bench-main flock marker check in main() like every rung.
 RUNGS.insert(3, bench._KZG_CELLS_RUNG_SMALL)
+# light-client serving rung (ISSUE 17): a batch of heterogeneous sync-
+# committee update sessions settled in ONE shared-accumulator pairing check.
+# Rides beside the KZG rung (same compile-warm story via .jax_cache); the
+# record embeds the engine's compile_probe pinning one pairing check per
+# batch, the host-loop twin rate, and the lc_device resilience stamp.
+# Starts only behind the bench-main flock marker check in main().
+RUNGS.insert(4, bench._LIGHT_CLIENTS_RUNG_SMALL)
 RUNGS.append(bench._EPOCH_RUNG_FULL)
 RUNGS.append(bench._EPOCH_SHARDED_RUNG_FULL)
 RUNGS.append(bench._SLASHER_RUNG_FULL)
@@ -286,6 +295,8 @@ def persist(rec: dict, rung_idx: int) -> None:
         ("pairing_sets_per_s", False): RECORD_PAIRING,
         ("slashable_checks_per_s", False): RECORD_SLASHER,
         ("slashable_checks_per_s", True): RECORD_SLASHER_SHARDED,
+        ("kzg_cells_verified_per_s", False): RECORD_KZG_CELLS,
+        ("light_clients_served_per_s", False): RECORD_LIGHT_CLIENTS,
     }.get((rec.get("metric"), sharded), RECORD)
     # ISSUE 13: best-record files are ALSO keyed by the record's conv-backend
     # stamp — a pallas record and a digits/f64 record measure different
