@@ -800,6 +800,300 @@ def _inner_firehose():
     )
 
 
+# Overload-protection SLOs (ISSUE 18): what the node must still deliver to
+# HONEST traffic while an abusive peer floods it at 10x quota. Declared next
+# to FIREHOSE_SLOS; every --overload record reports measured values against
+# these (see also pytest.ini's overload knobs).
+OVERLOAD_SLOS = {
+    "honest_p99_e2e_ms": 5000.0,   # gossip->verdict p99 for admitted honest work
+    "max_honest_drop_rate": 0.50,  # honest share shed under sustained abuse
+}
+
+
+def _inner_overload():
+    """Sustained-abuse rung (ISSUE 18): an honest paced attestation stream
+    plus a 10x malformed low-priority flood into the SAME firehose intake,
+    with a LoadMonitor folding intake depth / drop rate / lag into an
+    admission level. The record proves the overload-protection tier end to
+    end: honest throughput + gossip->verdict p50/p99 under abuse, admission
+    transitions, shed counts by priority, bounded queues, and an in-rung
+    HTTP probe asserting P1 routes get 503 + Retry-After while P0 duty
+    routes still get 200 at SATURATED. Zero false verifies is asserted from
+    the abuse callbacks (an abusive payload must never earn verdict True)."""
+    _enable_compile_cache()
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    if fallback:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import threading as _threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from lighthouse_tpu.beacon_chain.pubkey_cache import device_pubkeys_from_raw
+    from lighthouse_tpu.beacon_processor.processor import WorkType
+    from lighthouse_tpu.bls import tpu_backend as tb
+    from lighthouse_tpu.firehose import FirehoseConfig, FirehoseEngine
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.loadshed import AdmissionLevel, LoadMonitor, deadline_for
+
+    rate = float(os.environ.get("BENCH_OVERLOAD_RATE", "10000"))
+    abuse_x = float(os.environ.get("BENCH_OVERLOAD_ABUSE_X", "10"))
+    duration = float(os.environ.get("BENCH_OVERLOAD_SECONDS", "3.0"))
+    fh_batch = BATCH
+    intake = int(os.environ.get("BENCH_FIREHOSE_INTAKE", str(16 * fh_batch)))
+    drain_timeout = float(os.environ.get("BENCH_FIREHOSE_DRAIN_S", "120"))
+
+    platform = jax.devices()[0].platform
+    pks_comp, pks_raw, idx, msgs, sigs = _fixture()
+    cache = device_pubkeys_from_raw(pks_raw)
+    cache.block_until_ready()
+    pool = [
+        (idx[s].tolist(), msgs[s].tobytes(), sigs[s].tobytes())
+        for s in range(N_SETS)
+    ]
+
+    def prepare(payloads):
+        # abusive payloads are malformed gossip: they fail decode before
+        # any crypto (prep-stage Exception), the way real spam does
+        return [
+            ValueError("malformed gossip payload")
+            if isinstance(p, tuple) and p and p[0] == "abuse"
+            else ([p], None)
+            for p in payloads
+        ]
+
+    def verify(items):
+        return tb.verify_indexed_sets_device(cache, items)
+
+    t0 = time.perf_counter()
+    assert verify(pool[:fh_batch]), "overload warmup batch rejected"
+    print(
+        f"# overload warmup (compile) {time.perf_counter() - t0:.0f}s "
+        f"on {platform}",
+        flush=True,
+    )
+
+    from lighthouse_tpu.resilience import get_supervisor
+
+    engine = FirehoseEngine(
+        prepare_fn=prepare,
+        verify_items_fn=verify,
+        config=FirehoseConfig(
+            max_batch=fh_batch,
+            deadline_s=0.010,
+            intake_capacity=intake,
+        ),
+        supervisor=get_supervisor("bench.overload"),
+    )
+    monitor = LoadMonitor()
+    monitor.attach_batcher(engine.batcher)
+
+    # HTTP admission probe target: a stub chain is enough — the gate runs
+    # before any route handler, and the probed P0 route (node/syncing)
+    # reads only head.slot / current_slot
+    class _StubHead:
+        slot = 0
+
+    class _StubChain:
+        lock = _threading.Lock()
+        head = _StubHead()
+        execution_layer = None
+
+        def current_slot(self):
+            return 0
+
+    api = BeaconApiServer(_StubChain(), load_monitor=monitor).start()
+
+    def _probe():
+        out = {}
+        try:
+            with urllib.request.urlopen(
+                api.url + "/eth/v1/node/version", timeout=10
+            ) as r:
+                out["p1_status"] = r.status
+                out["p1_retry_after"] = None
+        except urllib.error.HTTPError as e:
+            out["p1_status"] = e.code
+            out["p1_retry_after"] = e.headers.get("Retry-After")
+        with urllib.request.urlopen(
+            api.url + "/eth/v1/node/syncing", timeout=10
+        ) as r:
+            out["p0_status"] = r.status
+        return out
+
+    cb_lock = _threading.Lock()
+    counts = {"honest_ok": 0, "honest_bad": 0, "false_verifies": 0,
+              "abuse_refused": 0}
+
+    def honest_cb(payload, ok, meta=None):
+        with cb_lock:
+            counts["honest_ok" if ok else "honest_bad"] += 1
+
+    def abuse_cb(payload, ok, meta=None):
+        with cb_lock:
+            counts["false_verifies" if ok else "abuse_refused"] += 1
+
+    abuse_rate = rate * abuse_x
+    t_start = time.perf_counter()
+    n_honest = n_abuse = 0
+    honest_gate_drops = abuse_gate_drops = 0
+    probe_result = None
+    per_tick_h = max(1, int(rate / 1000))
+    per_tick_a = max(1, int(abuse_rate / 1000))
+    while True:
+        elapsed = time.perf_counter() - t_start
+        if elapsed >= duration:
+            break
+        now = time.monotonic()
+        hd = deadline_for(WorkType.GossipAttestation, now=now)
+        target_h = min(int(rate * elapsed) + per_tick_h, int(rate * duration))
+        while n_honest < target_h:
+            if not engine.submit(
+                pool[n_honest % len(pool)],
+                work_type=WorkType.GossipAttestation,
+                callback=honest_cb, ingest_at=now, deadline=hd,
+            ):
+                honest_gate_drops += 1
+            n_honest += 1
+        ad = deadline_for(WorkType.GossipSyncSignature, now=now)
+        target_a = min(
+            int(abuse_rate * elapsed) + per_tick_a, int(abuse_rate * duration)
+        )
+        while n_abuse < target_a:
+            if not engine.submit(
+                ("abuse", n_abuse),
+                work_type=WorkType.GossipSyncSignature,
+                callback=abuse_cb, ingest_at=now, deadline=ad,
+            ):
+                abuse_gate_drops += 1
+            n_abuse += 1
+        if probe_result is None and monitor.level() is AdmissionLevel.SATURATED:
+            probe_result = _probe()
+        time.sleep(0.001)
+    if probe_result is None and monitor.level() is AdmissionLevel.SATURATED:
+        probe_result = _probe()
+    engine.stop(drain_timeout=drain_timeout)
+    wall = time.perf_counter() - t_start
+    time.sleep(0.1)  # past the monitor's min sample interval: fresh level
+    healthy_after = _probe()  # intake drained: P1 admitted again
+    api.stop()
+    st = engine.stats()
+
+    # ---- in-rung assertions (the acceptance criteria, not post-hoc) ----
+    assert probe_result is not None, (
+        "monitor never reached SATURATED under a "
+        f"{abuse_x:.0f}x abuse flood — admission control unproven"
+    )
+    assert probe_result["p1_status"] == 503, probe_result
+    assert probe_result["p1_retry_after"] is not None, probe_result
+    assert probe_result["p0_status"] == 200, probe_result
+    assert counts["false_verifies"] == 0, counts
+    assert engine.batcher.high_water <= intake, (
+        engine.batcher.high_water, intake,
+    )
+    drops_by_type = {
+        t.name: n for t, n in sorted(engine.batcher.dropped.items(),
+                                     key=lambda kv: kv[0].value)
+    }
+    honest_dropped = drops_by_type.get("GossipAttestation", 0)
+    abuse_dropped = drops_by_type.get("GossipSyncSignature", 0)
+    honest_drop_rate = honest_dropped / n_honest if n_honest else 0.0
+    abuse_drop_rate = abuse_dropped / n_abuse if n_abuse else 0.0
+    # lowest-priority-first: the flood's type must shed at a strictly
+    # higher rate than the honest (higher-priority) stream
+    assert abuse_drop_rate >= honest_drop_rate, (
+        abuse_drop_rate, honest_drop_rate,
+    )
+
+    p50_ms = st.p50_e2e_s * 1e3 if st.p50_e2e_s is not None else None
+    p99_ms = st.p99_e2e_s * 1e3 if st.p99_e2e_s is not None else None
+    print(
+        json.dumps(
+            {
+                "metric": "overload_honest_atts_per_s",
+                "value": round(counts["honest_ok"] / wall, 2),
+                "unit": "att/s",
+                "platform": platform,
+                **_backend_stamp(),
+                "fallback": fallback,
+                "stream": {
+                    "honest_att_per_s": rate,
+                    "abuse_multiplier": abuse_x,
+                    "duration_s": duration,
+                    "honest_offered": n_honest,
+                    "abuse_offered": n_abuse,
+                    "batch": fh_batch,
+                    "intake_capacity": intake,
+                    "validators": N_VALIDATORS,
+                    "pool_sets": N_SETS,
+                },
+                "honest": {
+                    "verified": counts["honest_ok"],
+                    "rejected": counts["honest_bad"],
+                    "dropped": honest_dropped,
+                    "drop_rate": round(honest_drop_rate, 4),
+                },
+                "abuse": {
+                    "refused": counts["abuse_refused"],
+                    "false_verifies": counts["false_verifies"],
+                    "dropped": abuse_dropped,
+                    "drop_rate": round(abuse_drop_rate, 4),
+                },
+                "gossip_verdict_p50_ms": (
+                    round(p50_ms, 2) if p50_ms is not None else None
+                ),
+                "gossip_verdict_p99_ms": (
+                    round(p99_ms, 2) if p99_ms is not None else None
+                ),
+                "admission": {
+                    "transitions": monitor.transitions(),
+                    "final_level": monitor.level().name,
+                    "probe_at_saturated": probe_result,
+                    "probe_after_drain": healthy_after,
+                },
+                "shed": {
+                    "intake_drops_by_type": drops_by_type,
+                    "expired_by_type": {
+                        t.name: n for t, n in engine.batcher.expired.items()
+                    },
+                    "evicted": engine.batcher.evicted,
+                },
+                "queues": {
+                    "intake_high_water": engine.batcher.high_water,
+                    "intake_capacity": intake,
+                    "bounded": engine.batcher.high_water <= intake,
+                },
+                "slo": {
+                    "declared": dict(OVERLOAD_SLOS),
+                    "measured": {
+                        "honest_p99_e2e_ms": (
+                            round(p99_ms, 2) if p99_ms is not None else None
+                        ),
+                        "honest_drop_rate": round(honest_drop_rate, 4),
+                    },
+                    "met": {
+                        "honest_p99_e2e_ms": (
+                            p99_ms is not None
+                            and p99_ms <= OVERLOAD_SLOS["honest_p99_e2e_ms"]
+                        ),
+                        "honest_drop_rate": (
+                            honest_drop_rate
+                            <= OVERLOAD_SLOS["max_honest_drop_rate"]
+                        ),
+                    },
+                },
+                "batches_formed": st.batches_formed,
+                "device_faults": st.device_faults,
+                "resilience": _resilience_summary(),
+                "wall_s": round(wall, 2),
+            }
+        )
+    )
+
+
 def _mesh_devices_for_inner(platform: str) -> int:
     """Resolve BENCH_MESH_DEVICES inside an --inner process: on a CPU
     platform that exposes fewer devices, rebuild the client with virtual
@@ -1783,6 +2077,11 @@ _LADDER = [
 # stream rate/duration come from BENCH_FIREHOSE_* env (default 50k att/s).
 _FIREHOSE_RUNG = (256, 1, 4096, 16, 1800.0, "firehose")
 
+# Sustained-abuse overload rung (ISSUE 18): the firehose gossip shape with
+# an honest paced stream plus a 10x malformed low-priority flood; the rates
+# come from BENCH_OVERLOAD_* env (default 10k honest att/s, 10x abuse).
+_OVERLOAD_RUNG = (256, 1, 4096, 16, 1800.0, "overload")
+
 # Sharded serving-tier rung (the multi-chip firehose): same gossip shape,
 # but the engine forms n_devices fixed sub-batches of `batch` per tick and
 # verifies them data-parallel over the mesh with per-shard verdicts; the
@@ -1874,6 +2173,7 @@ def _hunter_record(mode: str = "sets") -> dict | None:
     probe-log tail proving the window hunt."""
     name = {
         "firehose": "tpu_firehose_record.json",
+        "overload": "tpu_overload_record.json",
         "firehose_sharded": "tpu_firehose_sharded_record.json",
         "epoch": "tpu_epoch_record.json",
         "epoch_sharded": "tpu_epoch_sharded_record.json",
@@ -1964,6 +2264,8 @@ def main():
         mode = "firehose_sharded"
     elif "--firehose" in sys.argv:
         mode = "firehose"
+    elif "--overload" in sys.argv:
+        mode = "overload"
     elif "--epoch-sharded" in sys.argv:
         mode = "epoch_sharded"
     elif "--epoch" in sys.argv:
@@ -1982,6 +2284,8 @@ def main():
         inner_mode = os.environ.get("BENCH_MODE", mode)
         if inner_mode == "firehose":
             _inner_firehose()
+        elif inner_mode == "overload":
+            _inner_overload()
         elif inner_mode == "firehose_sharded":
             _inner_firehose_sharded()
         elif inner_mode in ("epoch", "epoch_sharded"):
@@ -2036,6 +2340,12 @@ def _main_measure(mode: str) -> None:
             # wedged tunnel: a shorter, lower-rate CPU stream (the device
             # batch path is orders of magnitude slower on CPU; the engine
             # shedding most of a 50k/s offer is the honest record)
+            ladder = [(128, 1, 2048, 16, 1800.0)]
+    elif mode == "overload":
+        ladder = [_OVERLOAD_RUNG[:5]]
+        if fallback:
+            # wedged tunnel: same abuse multiplier at a lower honest rate —
+            # saturation (the thing measured) arrives even faster on CPU
             ladder = [(128, 1, 2048, 16, 1800.0)]
     elif mode == "firehose_sharded":
         ladder = [_FIREHOSE_SHARDED_RUNG[:5]]
@@ -2118,6 +2428,7 @@ def _main_measure(mode: str) -> None:
     # every rung failed: emit an honest failure record rather than nothing
     metric = {
         "firehose": "firehose_attestations_verified_per_s",
+        "overload": "overload_honest_atts_per_s",
         "firehose_sharded": "firehose_attestations_verified_per_s",
         "epoch": "epoch_validators_per_s",
         "epoch_sharded": "epoch_validators_per_s",
@@ -2133,7 +2444,8 @@ def _main_measure(mode: str) -> None:
                 "metric": metric,
                 "value": 0.0,
                 "unit": {
-                    "firehose": "att/s", "firehose_sharded": "att/s",
+                    "firehose": "att/s", "overload": "att/s",
+                    "firehose_sharded": "att/s",
                     "epoch": "validators/s",
                     "epoch_sharded": "validators/s",
                     "h2c": "points/s", "pairing": "sets/s",

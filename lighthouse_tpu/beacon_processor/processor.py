@@ -15,10 +15,16 @@ from __future__ import annotations
 import enum
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
-from ..utils.metrics import PROCESSOR_QUEUE_LENGTH, PROCESSOR_WORK_EVENTS
+from ..utils.metrics import (
+    PROCESSOR_EXPIRED_DROPS,
+    PROCESSOR_OVERFLOW_DROPS,
+    PROCESSOR_QUEUE_LENGTH,
+    PROCESSOR_WORK_EVENTS,
+)
 
 
 class WorkType(enum.Enum):
@@ -60,12 +66,19 @@ _BATCHABLE = {WorkType.GossipAttestation, WorkType.GossipAggregate}
 @dataclass
 class Work:
     """One unit of work. ``process_individual(item)`` handles a single item;
-    ``process_batch(items)`` an entire batch (lib.rs:555-571)."""
+    ``process_batch(items)`` an entire batch (lib.rs:555-571).
+
+    ``ingest_at``/``deadline`` carry the wire-ingest monotonic timestamp and
+    the work's absolute expiry (loadshed.deadline): expired work is dropped
+    BEFORE it reaches any handler or device dispatch. ``deadline=None``
+    means the work never expires (the legacy behaviour)."""
 
     work_type: WorkType
     item: object
     process_individual: object = None
     process_batch: object = None
+    ingest_at: float = field(default_factory=time.monotonic)
+    deadline: float | None = None
 
 
 @dataclass
@@ -113,6 +126,7 @@ class BeaconProcessor:
         self.firehose = firehose
         self.queues: dict[WorkType, deque] = {t: deque() for t in WorkType}
         self.dropped: dict[WorkType, int] = {t: 0 for t in WorkType}
+        self.expired: dict[WorkType, int] = {t: 0 for t in WorkType}
         self.processed: dict[WorkType, int] = {t: 0 for t in WorkType}
         self.batches_formed = 0
         self._lock = threading.Lock()
@@ -131,6 +145,13 @@ class BeaconProcessor:
     # -- submission (back-pressure at enqueue, drop on overflow) -----------------
 
     def submit(self, work: Work) -> bool:
+        if work.deadline is not None and time.monotonic() > work.deadline:
+            # already expired at ingest: never spend queue space or BLS
+            # cycles on work whose client/inclusion window has passed
+            with self._lock:
+                self.expired[work.work_type] += 1
+            PROCESSOR_EXPIRED_DROPS.inc(work_type=work.work_type.name)
+            return False
         if (
             self.firehose is not None
             and work.work_type in _BATCHABLE
@@ -139,7 +160,10 @@ class BeaconProcessor:
         ):
             # firehose-eligible gossip work: the engine owns batching,
             # back-pressure and verdict application end to end
-            ok = self.firehose.submit(work.item, work_type=work.work_type)
+            ok = self.firehose.submit(
+                work.item, work_type=work.work_type,
+                ingest_at=work.ingest_at, deadline=work.deadline,
+            )
             with self._lock:
                 if ok:
                     PROCESSOR_WORK_EVENTS.inc(work_type=work.work_type.name)
@@ -150,7 +174,13 @@ class BeaconProcessor:
             q = self.queues[work.work_type]
             if len(q) >= self.config.queue_lengths.limit(work.work_type):
                 self.dropped[work.work_type] += 1
-                return False
+                PROCESSOR_OVERFLOW_DROPS.inc(work_type=work.work_type.name)
+                if work.work_type not in _LIFO:
+                    return False
+                # freshest-first queues evict the OLDEST item (the tail)
+                # and admit the fresh one: under overload the stale end of
+                # an attestation queue is the least likely to still matter
+                q.pop()
             if work.work_type in _LIFO:
                 q.appendleft(work)
             else:
@@ -164,21 +194,44 @@ class BeaconProcessor:
 
     # -- scheduling --------------------------------------------------------------
 
+    def _expired_locked(self, w: Work, now: float) -> bool:
+        """Deadline check at dispatch time; counts the drop. Caller holds
+        the lock."""
+        if w.deadline is None or now <= w.deadline:
+            return False
+        self.expired[w.work_type] += 1
+        PROCESSOR_EXPIRED_DROPS.inc(work_type=w.work_type.name)
+        return True
+
     def _pop_next(self):
         """Highest-priority nonempty queue -> one Work or a formed batch.
-        Caller holds the lock."""
+        Expired work is shed here — the last gate before any handler or
+        BLS/device dispatch. Caller holds the lock."""
         for t in WorkType:
             q = self.queues[t]
             if not q:
                 continue
+            now = time.monotonic()
             if t in _BATCHABLE and len(q) > 1:
                 n = min(len(q), self.config.max_batch_size)
-                items = [q.popleft() for _ in range(n)]
-                self.batches_formed += 1
+                items = []
+                while q and len(items) < n:
+                    w = q.popleft()
+                    if not self._expired_locked(w, now):
+                        items.append(w)
                 PROCESSOR_QUEUE_LENGTH.set(len(q), work_type=t.name)
+                if not items:
+                    continue
+                if len(items) == 1:
+                    return ("one", t, items[0])
+                self.batches_formed += 1
                 return ("batch", t, items)
             popped = q.popleft()
+            while popped is not None and self._expired_locked(popped, now):
+                popped = q.popleft() if q else None
             PROCESSOR_QUEUE_LENGTH.set(len(q), work_type=t.name)
+            if popped is None:
+                continue
             return ("one", t, popped)
         return None
 

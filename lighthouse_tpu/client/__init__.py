@@ -358,6 +358,9 @@ class ClientBuilder:
             http_server = BeaconApiServer(
                 chain, op_pool=op_pool, port=cfg.http_port,
                 network_service=network_service,
+                load_monitor=getattr(
+                    network_service, "load_monitor", None
+                ),
             )
 
         metrics_server = None

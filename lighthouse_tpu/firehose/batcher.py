@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from ..beacon_processor.processor import WorkType, _LIFO
 from ..utils.metrics import (
     FIREHOSE_DROPPED,
+    FIREHOSE_EXPIRED,
     FIREHOSE_INTAKE_DEPTH,
 )
 
@@ -37,12 +38,19 @@ from ..utils.metrics import (
 @dataclass
 class FirehoseItem:
     """One unit of streaming work plus its intake timestamp (queue-latency
-    measurement runs enqueue -> verdict)."""
+    measurement runs enqueue -> verdict).
+
+    ``ingest_at`` is the earlier WIRE-ingest stamp when the item rode the
+    gossip pipeline before reaching the intake (end-to-end gossip->verdict
+    latency runs from it); ``deadline`` is the absolute monotonic expiry —
+    expired items are shed at batch-form time, before any device dispatch."""
 
     work_type: WorkType
     payload: object
     callback: object = None          # callback(payload, ok: bool) after verify
     enqueued_at: float = field(default_factory=time.monotonic)
+    ingest_at: float | None = None
+    deadline: float | None = None
 
 
 @dataclass
@@ -68,8 +76,11 @@ class AdaptiveBatcher:
         self._ready = threading.Condition(self._lock)
         self._closed = False
         self.dropped: dict[WorkType, int] = {}
+        self.expired: dict[WorkType, int] = {}
         self.submitted = 0   # ACCEPTED items (gate rejections not included)
         self.evicted = 0     # accepted items later shed by back-pressure
+        self.high_water = 0  # max total intake depth ever observed
+        self._expired_out: list[FirehoseItem] = []  # await callbacks
 
     # -- intake (non-blocking; called from network/gossip threads) ---------------
 
@@ -97,6 +108,8 @@ class AdaptiveBatcher:
                 q.append(item)
             self._depth += 1
             self.submitted += 1
+            if self._depth > self.high_water:
+                self.high_water = self._depth
             FIREHOSE_INTAKE_DEPTH.set(len(q), work_type=t.name)
             self._ready.notify()
         return True
@@ -132,6 +145,25 @@ class AdaptiveBatcher:
                 return self._depth
             return len(self._queues.get(t, ()))
 
+    @property
+    def dropped_total(self) -> int:
+        with self._lock:
+            return sum(self.dropped.values()) + sum(self.expired.values())
+
+    def oldest_age(self) -> float | None:
+        """Age (s) of the oldest buffered item — the LoadMonitor's worker-lag
+        signal. None when the intake is empty."""
+        now = time.monotonic()
+        with self._lock:
+            best = None
+            for t, q in self._queues.items():
+                if not q:
+                    continue
+                oldest = q[-1] if t in _LIFO else q[0]
+                if best is None or oldest.enqueued_at < best:
+                    best = oldest.enqueued_at
+            return None if best is None else now - best
+
     def _oldest_deadline(self) -> float | None:
         """Earliest flush time over nonempty queues. Caller holds the lock."""
         best = None
@@ -160,16 +192,55 @@ class AdaptiveBatcher:
                 or now - oldest.enqueued_at >= self.config.deadline_s
             ):
                 n = min(len(q), self.config.max_batch)
-                batch = [q.popleft() for _ in range(n)]
-                self._depth -= n
+                batch = []
+                expired = []
+                while q and len(batch) < n:
+                    it = q.popleft()
+                    self._depth -= 1
+                    # per-item deadline: expired work is shed HERE, the
+                    # last host-side gate before device dispatch
+                    if it.deadline is not None and now > it.deadline:
+                        expired.append(it)
+                        self.expired[t] = self.expired.get(t, 0) + 1
+                        FIREHOSE_EXPIRED.inc(work_type=t.name)
+                    else:
+                        batch.append(it)
                 FIREHOSE_INTAKE_DEPTH.set(len(q), work_type=t.name)
+                # callbacks fire outside the lock (see _fire_expired)
+                self._expired_out.extend(expired)
+                if not batch:
+                    continue
                 return batch
         return None
+
+    @property
+    def expired_total(self) -> int:
+        with self._lock:
+            return sum(self.expired.values())
+
+    def _fire_expired(self) -> None:
+        """Deliver verdict=False callbacks for deadline-shed items, outside
+        the intake lock (a callback may log, score a peer, or resubmit)."""
+        with self._lock:
+            out, self._expired_out = self._expired_out, []
+        for it in out:
+            if it.callback is not None:
+                try:
+                    # engine-style callbacks take (payload, ok, meta)
+                    it.callback(it.payload, False, None)
+                except TypeError:
+                    it.callback(it.payload, False)
 
     def next_batch(self, timeout: float | None = None) -> list[FirehoseItem] | None:
         """Block until a batch is ready (full, or the oldest item's deadline
         expires), the batcher closes, or ``timeout`` elapses. Returns None
         on timeout/close with nothing buffered."""
+        try:
+            return self._next_batch_inner(timeout)
+        finally:
+            self._fire_expired()
+
+    def _next_batch_inner(self, timeout):
         give_up = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
@@ -200,8 +271,11 @@ class AdaptiveBatcher:
     def form_now(self) -> list[FirehoseItem] | None:
         """Form a batch immediately regardless of deadlines (synchronous
         drain mode)."""
-        with self._lock:
-            return self._form_locked(force=True)
+        try:
+            with self._lock:
+                return self._form_locked(force=True)
+        finally:
+            self._fire_expired()
 
     def close(self) -> None:
         """Stop accepting new work; ``next_batch`` drains what remains then

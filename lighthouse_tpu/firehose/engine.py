@@ -35,6 +35,7 @@ from ..utils.metrics import (
     FIREHOSE_BATCHES_FORMED,
     FIREHOSE_QUEUE_LATENCY,
     FIREHOSE_VERIFIED,
+    GOSSIP_VERDICT_LATENCY,
 )
 from .batcher import AdaptiveBatcher, FirehoseConfig, FirehoseItem
 from .bisect import bisect_verify
@@ -53,6 +54,11 @@ class FirehoseStats:
     p50_latency_s: float | None
     p99_latency_s: float | None
     device_faults: int = 0
+    expired: int = 0
+    # end-to-end gossip->verdict percentiles: measured from the WIRE-ingest
+    # stamp when items carry one (falls back to intake enqueue time)
+    p50_e2e_s: float | None = None
+    p99_e2e_s: float | None = None
 
     def as_dict(self) -> dict:
         return {
@@ -65,6 +71,9 @@ class FirehoseStats:
             "p50_latency_s": self.p50_latency_s,
             "p99_latency_s": self.p99_latency_s,
             "device_faults": self.device_faults,
+            "expired": self.expired,
+            "p50_e2e_s": self.p50_e2e_s,
+            "p99_e2e_s": self.p99_e2e_s,
         }
 
 
@@ -121,6 +130,7 @@ class FirehoseEngine:
         self.batches_formed = 0
         self.device_faults = 0     # batches that lost their device verdict
         self._latencies: list[float] = []
+        self._e2e_latencies: list[float] = []  # wire-ingest -> verdict
         self._stats_lock = threading.Lock()
         self._prepared: queue.Queue = queue.Queue(maxsize=self.config.prep_depth)
         self._threads: list[threading.Thread] = []
@@ -142,10 +152,18 @@ class FirehoseEngine:
         payload,
         work_type: WorkType = WorkType.GossipAttestation,
         callback=None,
+        ingest_at: float | None = None,
+        deadline: float | None = None,
     ) -> bool:
-        """Non-blocking intake. Returns False when the item was shed."""
+        """Non-blocking intake. Returns False when the item was shed.
+        ``ingest_at``/``deadline`` propagate the wire-ingest stamp and the
+        item's expiry (loadshed.deadline): expired items are shed at batch
+        form time and end-to-end latency is measured from ``ingest_at``."""
         return self.batcher.submit(
-            FirehoseItem(work_type=work_type, payload=payload, callback=callback)
+            FirehoseItem(
+                work_type=work_type, payload=payload, callback=callback,
+                ingest_at=ingest_at, deadline=deadline,
+            )
         )
 
     # -- pipeline stages ----------------------------------------------------------
@@ -259,6 +277,7 @@ class FirehoseEngine:
         now = time.monotonic()
         n_ok = n_bad = n_err = 0
         lats = []
+        e2e_lats = []
         ri = 0
         for it, entry in zip(batch, entries):
             meta = None
@@ -277,6 +296,10 @@ class FirehoseEngine:
                     n_ok += ok
                     n_bad += not ok
             lats.append(now - it.enqueued_at)
+            e2e_lats.append(
+                now - (it.ingest_at if it.ingest_at is not None
+                       else it.enqueued_at)
+            )
             cb = it.callback or self.default_callback
             if cb is not None:
                 try:
@@ -290,8 +313,13 @@ class FirehoseEngine:
             self._latencies.extend(lats)
             if len(self._latencies) > _LATENCY_RESERVOIR:
                 del self._latencies[: -_LATENCY_RESERVOIR]
+            self._e2e_latencies.extend(e2e_lats)
+            if len(self._e2e_latencies) > _LATENCY_RESERVOIR:
+                del self._e2e_latencies[: -_LATENCY_RESERVOIR]
         for v in lats:
             FIREHOSE_QUEUE_LATENCY.observe(v)
+        for v in e2e_lats:
+            GOSSIP_VERDICT_LATENCY.observe(v)
         FIREHOSE_VERIFIED.inc(n_ok, result="ok")
         if n_bad:
             FIREHOSE_VERIFIED.inc(n_bad, result="bad_signature")
@@ -369,7 +397,8 @@ class FirehoseEngine:
         while time.monotonic() < deadline:
             with self._stats_lock:
                 settled = self.verified + self.rejected + self.errored
-            if settled + self.batcher.evicted >= self.batcher.submitted:
+            shed = self.batcher.evicted + sum(self.batcher.expired.values())
+            if settled + shed >= self.batcher.submitted:
                 return True
             time.sleep(0.005)
         faults.record_fault(
@@ -431,6 +460,7 @@ class FirehoseEngine:
     def stats(self) -> FirehoseStats:
         with self._stats_lock:
             lats = sorted(self._latencies)
+            e2e = sorted(self._e2e_latencies)
             return FirehoseStats(
                 submitted=self.batcher.submitted,
                 verified=self.verified,
@@ -441,6 +471,9 @@ class FirehoseEngine:
                 p50_latency_s=self._percentile(lats, 0.50),
                 p99_latency_s=self._percentile(lats, 0.99),
                 device_faults=self.device_faults,
+                expired=sum(self.batcher.expired.values()),
+                p50_e2e_s=self._percentile(e2e, 0.50),
+                p99_e2e_s=self._percentile(e2e, 0.99),
             )
 
     def resilience(self) -> dict | None:

@@ -14,6 +14,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..loadshed import AdmissionLevel, is_p0_route
 from ..state_transition import (
     get_beacon_committee,
     get_beacon_proposer_index,
@@ -22,6 +23,7 @@ from ..state_transition import (
 )
 from ..types.containers import AttestationData, Checkpoint
 from ..types.helpers import compute_fork_digest
+from ..utils.metrics import SHED_REQUESTS
 
 
 def _hex(b: bytes) -> str:
@@ -43,10 +45,15 @@ class BeaconApiServer:
     a BeaconNodeService provides both) behind the Beacon API."""
 
     def __init__(self, chain, op_pool=None, network_service=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 load_monitor=None):
         self.chain = chain
         self.op_pool = op_pool
         self.network = network_service
+        # admission control: when the node is SATURATED, P1 (non-duty)
+        # routes are refused with 503 + Retry-After; P0 duty routes are
+        # always admitted (shedding a proposal costs more than any queue)
+        self.load_monitor = load_monitor
         self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
@@ -1046,11 +1053,13 @@ def _make_handler(api: BeaconApiServer):
         def log_message(self, *args):  # quiet
             pass
 
-        def _reply(self, code: int, payload) -> None:
+        def _reply(self, code: int, payload, retry_after=None) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            if retry_after is not None:
+                self.send_header("Retry-After", str(int(retry_after)))
             self.end_headers()
             self.wfile.write(data)
 
@@ -1114,6 +1123,21 @@ def _make_handler(api: BeaconApiServer):
                     if not match:
                         continue
                     q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                    mon = api.load_monitor
+                    if (
+                        mon is not None
+                        and not is_p0_route(name)
+                        and mon.level() is AdmissionLevel.SATURATED
+                    ):
+                        # P1 load is refused while saturated so duty-path
+                        # (P0) requests keep their latency budget
+                        SHED_REQUESTS.inc(surface="http_api", priority="p1")
+                        self._reply(
+                            503,
+                            {"message": "node overloaded, retry later"},
+                            retry_after=mon.retry_after_s(),
+                        )
+                        return
                     if name == "events":
                         topics = [
                             t for t in q.get("topics", "head").split(",") if t

@@ -125,7 +125,8 @@ class GossipsubTransport(SocketTransport):
                  params: GossipsubParams | None = None,
                  topics: list[str] | None = None,
                  run_heartbeat: bool = True,
-                 peer_manager=None, discovery=None):
+                 peer_manager=None, discovery=None,
+                 self_limit: bool = True):
         self.params = params or GossipsubParams()
         self._gs_lock = threading.RLock()
         self._subs: set[str] = set()
@@ -151,8 +152,11 @@ class GossipsubTransport(SocketTransport):
                 v for k, v in vars(Topic).items() if not k.startswith("_")
             ]
         self._subs.update(topics)
+        # honest-node default: self-limit our own Req/Resp against the
+        # peer's quotas so a full node never trips a remote rate limiter
         super().__init__(spec, host=host, port=port, rpc_timeout=rpc_timeout,
-                         peer_manager=peer_manager, discovery=discovery)
+                         peer_manager=peer_manager, discovery=discovery,
+                         self_limit=self_limit)
         self._hb_thread = None
         if run_heartbeat:
             self._hb_thread = threading.Thread(

@@ -62,6 +62,7 @@ class RateLimiter:
         self._buckets: dict[tuple[str, str], _Bucket] = {}
         self._lock = threading.Lock()
         self._clock = clock
+        self._last_prune = clock()
 
     def allow(self, peer: str, method: str, tokens: float = 1.0) -> bool:
         """Spend ``tokens`` from (peer, method)'s bucket; False = refused.
@@ -93,6 +94,38 @@ class RateLimiter:
             for key in [k for k, b in self._buckets.items()
                         if b.last < cutoff]:
                 del self._buckets[key]
+
+    def maybe_prune(self, max_age: float = 60.0) -> bool:
+        """Time-gated :meth:`prune` — cheap enough for the transport's
+        serve loop to call per request; actually prunes at most once per
+        ``max_age``. Without this the per-(peer, method) bucket map grows
+        without bound over long DHT walks. Returns True iff it pruned."""
+        now = self._clock()
+        with self._lock:
+            if now - self._last_prune < max_age:
+                return False
+            self._last_prune = now
+        self.prune(max_age)
+        return True
+
+    def wait_time(self, peer: str, method: str, tokens: float = 1.0) -> float:
+        """Seconds until ``tokens`` would be available for (peer, method)
+        — 0.0 if a request would be admitted now, ``inf`` if ``tokens``
+        exceeds the quota outright. Does NOT spend tokens: the client-side
+        self-limiter uses this to pace itself below a peer's refill rate."""
+        quota = self.quotas.get(method, _DEFAULT)
+        if tokens > quota.max_tokens:
+            return float("inf")
+        now = self._clock()
+        rate = quota.max_tokens / quota.period
+        with self._lock:
+            b = self._buckets.get((peer, method))
+            if b is None:
+                return 0.0
+            have = min(quota.max_tokens, b.tokens + (now - b.last) * rate)
+        if have >= tokens:
+            return 0.0
+        return (tokens - have) / rate
 
 
 def request_cost(method: str, payload) -> float:

@@ -10,92 +10,98 @@ the scheduler can form attestation/aggregate batches for the device backend
 
 from __future__ import annotations
 
+import time
+
 from ..beacon_processor.processor import Work, WorkType
+from ..loadshed import DEFAULT_SLOT_SECONDS, deadline_for
 from .transport import Topic
 
 
 class Router:
     def __init__(self, service):
         self.svc = service
+        try:
+            self._slot_seconds = float(
+                service.chain.spec.preset.SECONDS_PER_SLOT
+            )
+        except AttributeError:
+            self._slot_seconds = DEFAULT_SLOT_SECONDS
+
+    def _stamp(self, work: Work) -> Work:
+        """Deadline propagation starts at the wire: every gossip Work item
+        carries its ingest time plus a per-type processing deadline, so
+        stale work is dropped before it ever reaches BLS or the device."""
+        now = time.monotonic()
+        work.ingest_at = now
+        work.deadline = deadline_for(
+            work.work_type, now=now, slot_seconds=self._slot_seconds
+        )
+        return work
 
     # -- gossip ------------------------------------------------------------
 
     def on_gossip(self, topic: str, message, from_peer: str) -> None:
         svc = self.svc
+
+        def submit(**kw) -> None:
+            svc.processor.submit(self._stamp(Work(**kw)))
+
         if topic == Topic.BEACON_BLOCK:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipBlock,
-                    item=(message, from_peer),
-                    process_individual=svc.process_gossip_block,
-                )
+            submit(
+                work_type=WorkType.GossipBlock,
+                item=(message, from_peer),
+                process_individual=svc.process_gossip_block,
             )
         elif topic == Topic.BEACON_ATTESTATION:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipAttestation,
-                    item=message,
-                    process_individual=svc.process_gossip_attestation,
-                    process_batch=svc.process_gossip_attestation_batch,
-                )
+            submit(
+                work_type=WorkType.GossipAttestation,
+                item=message,
+                process_individual=svc.process_gossip_attestation,
+                process_batch=svc.process_gossip_attestation_batch,
             )
         elif topic == Topic.AGGREGATE_AND_PROOF:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipAggregate,
-                    item=message,
-                    process_individual=svc.process_gossip_aggregate,
-                    process_batch=svc.process_gossip_aggregate_batch,
-                )
+            submit(
+                work_type=WorkType.GossipAggregate,
+                item=message,
+                process_individual=svc.process_gossip_aggregate,
+                process_batch=svc.process_gossip_aggregate_batch,
             )
         elif topic == Topic.SYNC_COMMITTEE_MESSAGE:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipSyncSignature,
-                    item=message,
-                    process_individual=svc.process_gossip_sync_message,
-                    process_batch=svc.process_gossip_sync_message_batch,
-                )
+            submit(
+                work_type=WorkType.GossipSyncSignature,
+                item=message,
+                process_individual=svc.process_gossip_sync_message,
+                process_batch=svc.process_gossip_sync_message_batch,
             )
         elif topic == Topic.SYNC_CONTRIBUTION:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipSyncContribution,
-                    item=message,
-                    process_individual=svc.process_gossip_sync_contribution,
-                )
+            submit(
+                work_type=WorkType.GossipSyncContribution,
+                item=message,
+                process_individual=svc.process_gossip_sync_contribution,
             )
         elif topic == Topic.DATA_COLUMN_SIDECAR:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipBlock,  # block-class priority
-                    item=message,
-                    process_individual=svc.process_gossip_data_column,
-                )
+            submit(
+                work_type=WorkType.GossipBlock,  # block-class priority
+                item=message,
+                process_individual=svc.process_gossip_data_column,
             )
         elif topic == Topic.VOLUNTARY_EXIT:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipVoluntaryExit,
-                    item=message,
-                    process_individual=svc.process_gossip_exit,
-                )
+            submit(
+                work_type=WorkType.GossipVoluntaryExit,
+                item=message,
+                process_individual=svc.process_gossip_exit,
             )
         elif topic == Topic.PROPOSER_SLASHING:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipProposerSlashing,
-                    item=message,
-                    process_individual=svc.process_gossip_proposer_slashing,
-                )
+            submit(
+                work_type=WorkType.GossipProposerSlashing,
+                item=message,
+                process_individual=svc.process_gossip_proposer_slashing,
             )
         elif topic == Topic.ATTESTER_SLASHING:
-            svc.processor.submit(
-                Work(
-                    work_type=WorkType.GossipAttesterSlashing,
-                    item=message,
-                    process_individual=svc.process_gossip_attester_slashing,
-                )
+            submit(
+                work_type=WorkType.GossipAttesterSlashing,
+                item=message,
+                process_individual=svc.process_gossip_attester_slashing,
             )
         # unknown topics are dropped (gossipsub would penalize the peer)
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from ..beacon_chain.chain import BeaconChain, BlockError
 from ..beacon_processor.processor import BeaconProcessor, BeaconProcessorConfig
+from ..loadshed import LoadMonitor
 from ..op_pool import OperationPool
 from ..types.helpers import compute_fork_digest
 from .router import Router
@@ -46,6 +47,18 @@ class BeaconNodeService:
             BeaconProcessorConfig(), synchronous=True
         )
         self.op_pool = op_pool or OperationPool(spec, self.chain.ns.Attestation)
+        # overload-protection tier: one monitor folds processor queue
+        # depths, drop rates, and resilience-ladder state into an
+        # admission level shared by the HTTP API and Req/Resp surfaces
+        from ..resilience import snapshot_all
+
+        self.load_monitor = LoadMonitor()
+        self.load_monitor.attach_processor(self.processor)
+        self.load_monitor.attach_supervisors(snapshot_all)
+        if getattr(transport, "load_monitor", "absent") is None:
+            # socket transports expose the slot; the shared loopback
+            # transport (many nodes, one object) must not be clobbered
+            transport.load_monitor = self.load_monitor
         self.router = Router(self)
         # loopback runs sync inline (the deterministic simulator contract);
         # socket stacks get the dedicated sync worker thread

@@ -25,9 +25,13 @@ import hashlib
 import socket
 import struct
 import threading
+import time
 from collections import OrderedDict
 
+from ..loadshed.adaptive import RttEstimator, SelfLimiter
+from ..loadshed.priorities import method_priority, should_shed_method
 from ..utils.logging import get_logger
+from ..utils.metrics import RPC_EXPIRED, RPC_RTT, SHED_REQUESTS
 from .codec import MessageCodec, WireError
 from .transport import Transport
 
@@ -73,6 +77,9 @@ class _Peer:
         self.send_lock = threading.Lock()
         self.alive = True
         self.score = 0.0
+        # monotonic stamp of the recv() that completed the frame currently
+        # being handled: the server-side Req/Resp deadline runs from it
+        self.frame_recv_t = time.monotonic()
 
     def adjust_score(self, delta: float) -> float:
         self.score = max(-1000.0, min(100.0, self.score + delta))
@@ -93,11 +100,27 @@ class SocketTransport(Transport):
     same node code runs over loopback (tests) or real sockets."""
 
     def __init__(self, spec, host: str = "127.0.0.1", port: int = 0,
-                 rpc_timeout: float = 10.0, peer_manager=None, discovery=None):
+                 rpc_timeout: float = 10.0, peer_manager=None, discovery=None,
+                 self_limit: bool = False):
         from .peer_manager import PeerManager
 
         self.codec = MessageCodec(spec)
+        # rpc_timeout is the CEILING: per-peer adaptive timeouts (EWMA RTT +
+        # variance, RFC 6298 shape) take over once round-trips are observed
         self.rpc_timeout = rpc_timeout
+        self._rtt: dict[str, RttEstimator] = {}
+        self._rtt_lock = threading.Lock()
+        # server-side Req/Resp deadline: a request that waited in the read
+        # pipeline longer than any well-behaved client waits is answered
+        # with an error instead of doing the (now pointless) work
+        self.server_deadline_s = rpc_timeout
+        # optional loadshed.LoadMonitor: when attached, lowest-priority
+        # Req/Resp methods are shed first under BUSY/SATURATED
+        self.load_monitor = None
+        # client-side self-limiting (honest-node mode): pace our own
+        # requests under the peer's published quotas so we never trip a
+        # remote rate limiter and never take its -20 score penalty
+        self.self_limiter = SelfLimiter() if self_limit else None
         self._service = None
         # durable peer records + ban lifecycle (peer_manager/mod.rs parity):
         # scores and bans survive the TCP connection, so reconnects by a
@@ -152,6 +175,8 @@ class SocketTransport(Transport):
             for p in self._peers.values():
                 p.score *= SCORE_DECAY
         self.peer_manager.decay_scores()
+        # ride the same periodic tick to bound the rate-limiter bucket map
+        self.rate_limiter.maybe_prune()
 
     def report_peer(self, addr: str, delta: float) -> None:
         """Application-level score report (sync demotions etc. — the
@@ -182,10 +207,49 @@ class SocketTransport(Transport):
         self.published += 1
         self._flood(body, except_addr=None)
 
+    def peer_timeout(self, addr: str) -> float:
+        """Current request timeout for ``addr``: adaptive (EWMA RTT +
+        variance) once samples exist, the ``rpc_timeout`` ceiling before."""
+        with self._rtt_lock:
+            est = self._rtt.get(addr)
+            if est is None or not est.samples:
+                return self.rpc_timeout
+            return est.timeout()
+
+    def _rtt_for_locked(self, addr: str) -> RttEstimator:
+        est = self._rtt.get(addr)
+        if est is None:
+            est = self._rtt[addr] = RttEstimator(
+                max_timeout=self.rpc_timeout
+            )
+        return est
+
+    def _self_limit(self, to_peer: str, method: str, payload) -> None:
+        """Honest-client pacing: wait out our own shadow of the peer's
+        quota instead of tripping its limiter (and its score penalty)."""
+        if self.self_limiter is None:
+            return
+        from .rate_limiter import request_cost
+
+        cost = request_cost(method, payload)
+        wait = self.self_limiter.throttle(to_peer, method, cost)
+        if wait <= 0:
+            return
+        if wait > self.rpc_timeout:
+            raise ConnectionError(
+                f"self-limited: {method} to {to_peer} needs {wait:.1f}s "
+                "of quota refill"
+            )
+        time.sleep(wait)
+        # tokens have refilled; spend them (a second refusal only happens
+        # under concurrent senders — treat it as paced enough and proceed)
+        self.self_limiter.throttle(to_peer, method, cost)
+
     def request(self, from_peer: str, to_peer: str, method: str, payload):
         peer = self._peers.get(to_peer)
         if peer is None or not peer.alive:
             raise ConnectionError(f"not connected to {to_peer}")
+        self._self_limit(to_peer, method, payload)
         with self._lock:
             self._req_id += 1
             rid = self._req_id
@@ -197,13 +261,25 @@ class SocketTransport(Transport):
             + method.encode()
             + self.codec.encode_request(method, payload)
         )
+        timeout = self.peer_timeout(to_peer)
+        t0 = time.monotonic()
         try:
             peer.send_frame(_REQ, body)
-            if not ev.wait(self.rpc_timeout):
-                raise ConnectionError(f"rpc {method} to {to_peer} timed out")
+            if not ev.wait(timeout):
+                with self._rtt_lock:
+                    self._rtt_for_locked(to_peer).on_timeout()
+                raise ConnectionError(
+                    f"rpc {method} to {to_peer} timed out after {timeout:.2f}s"
+                )
         finally:
             with self._lock:
                 self._pending.pop(rid, None)
+        # any completed round trip (including an ERROR reply) is an RTT
+        # sample for the adaptive timeout
+        rtt = time.monotonic() - t0
+        with self._rtt_lock:
+            self._rtt_for_locked(to_peer).observe(rtt)
+        RPC_RTT.observe(rtt)
         kind, data = box[0]
         if kind == _ERROR:
             raise ConnectionError(data.decode(errors="replace"))
@@ -357,6 +433,7 @@ class SocketTransport(Transport):
                 self._drop_peer(peer, "closed")
                 return
             buf += chunk
+            peer.frame_recv_t = time.monotonic()
             while len(buf) >= 4:
                 (n,) = struct.unpack(">I", buf[:4])
                 if n > _MAX_FRAME or n < 1:
@@ -431,12 +508,40 @@ class SocketTransport(Transport):
             method = body[9 : 9 + mn].decode()
             payload = self.codec.decode_request(method, body[9 + mn :])
             cost = request_cost(method, payload)
+            # serve-loop prune keeps the per-(peer, method) bucket map
+            # bounded over long peer churn (time-gated, usually a no-op)
+            self.rate_limiter.maybe_prune()
             if not self.rate_limiter.allow(peer.addr, method, cost):
                 peer.send_frame(
                     _ERROR, struct.pack(">Q", rid) + b"rate limited"
                 )
                 if self._score(peer, SCORE_RATE_LIMITED):
                     self._drop_peer(peer, "banned (rpc flood)")
+                return
+            # admission-level shedding: lowest-priority methods are refused
+            # first when the node is BUSY/SATURATED. No score penalty — the
+            # peer did nothing wrong; OUR load is the problem.
+            lvl = (self.load_monitor.level()
+                   if self.load_monitor is not None else None)
+            if lvl is not None and should_shed_method(method, lvl):
+                SHED_REQUESTS.inc(
+                    surface="req_resp",
+                    priority=str(method_priority(method)),
+                )
+                peer.send_frame(
+                    _ERROR,
+                    struct.pack(">Q", rid) + b"overloaded: retry later",
+                )
+                return
+            # server-side deadline: a request that waited in the read
+            # pipeline past the client's timeout gets an error, not work —
+            # the response would be discarded anyway
+            if (time.monotonic() - peer.frame_recv_t
+                    > self.server_deadline_s):
+                RPC_EXPIRED.inc(method=method)
+                peer.send_frame(
+                    _ERROR, struct.pack(">Q", rid) + b"expired"
+                )
                 return
             try:
                 out = self._service.on_rpc(method, payload, peer.addr)

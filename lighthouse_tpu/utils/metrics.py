@@ -196,6 +196,48 @@ PROCESSOR_QUEUE_LENGTH = REGISTRY.gauge(
     "Current per-work-type queue length",
     label_names=("work_type",),
 )
+PROCESSOR_OVERFLOW_DROPS = REGISTRY.counter(
+    "beacon_processor_overflow_drops_total",
+    "Work dropped on queue overflow, per work type",
+    label_names=("work_type",),
+)
+PROCESSOR_EXPIRED_DROPS = REGISTRY.counter(
+    "beacon_processor_expired_drops_total",
+    "Work dropped past its deadline before dispatch, per work type",
+    label_names=("work_type",),
+)
+GOSSIP_VERDICT_LATENCY = REGISTRY.histogram(
+    "gossip_verdict_latency_seconds",
+    "End-to-end wire-ingest to verification-verdict latency",
+)
+ADMISSION_LEVEL = REGISTRY.gauge(
+    "loadshed_admission_level",
+    "Current admission level (0=HEALTHY 1=BUSY 2=SATURATED)",
+)
+ADMISSION_TRANSITIONS = REGISTRY.counter(
+    "loadshed_admission_transitions_total",
+    "Admission-level transitions",
+    label_names=("from_level", "to_level"),
+)
+SHED_REQUESTS = REGISTRY.counter(
+    "loadshed_shed_total",
+    "Requests shed by admission control, per surface and priority class",
+    label_names=("surface", "priority"),
+)
+RPC_EXPIRED = REGISTRY.counter(
+    "rpc_server_expired_total",
+    "Req/Resp requests dropped server-side past the client deadline",
+    label_names=("method",),
+)
+RPC_RTT = REGISTRY.histogram(
+    "rpc_rtt_seconds",
+    "Req/Resp round-trip times feeding the adaptive timeout estimator",
+)
+FIREHOSE_EXPIRED = REGISTRY.counter(
+    "firehose_expired_total",
+    "Firehose items dropped past their deadline before device dispatch",
+    label_names=("work_type",),
+)
 FIREHOSE_INTAKE_DEPTH = REGISTRY.gauge(
     "firehose_intake_depth",
     "Buffered items per work type in the firehose intake",

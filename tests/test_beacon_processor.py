@@ -61,14 +61,29 @@ class TestScheduler:
         assert seen[0] == 3  # newest attestation dispatched first
 
     def test_backpressure_drops(self):
+        # LIFO lanes (attestations): a full queue ADMITS the fresh item and
+        # evicts the oldest — recency wins, drops still counted
         ql = QueueLengths(overrides={WorkType.GossipAttestation: 2})
         p = BeaconProcessor(
             BeaconProcessorConfig(queue_lengths=ql), synchronous=False
         )
         p.shutdown()
         ok = [p.submit(Work(WorkType.GossipAttestation, i)) for i in range(5)]
-        assert ok == [True, True, False, False, False]
+        assert ok == [True] * 5
         assert p.dropped[WorkType.GossipAttestation] == 3
+        assert [w.item for w in p.queues[WorkType.GossipAttestation]] == [4, 3]
+
+    def test_backpressure_refuses_fifo_lanes(self):
+        # FIFO lanes (Req/Resp): a full queue refuses the ARRIVING item —
+        # in-flight requests are never evicted by new arrivals
+        ql = QueueLengths(overrides={WorkType.Status: 2})
+        p = BeaconProcessor(
+            BeaconProcessorConfig(queue_lengths=ql), synchronous=False
+        )
+        p.shutdown()
+        ok = [p.submit(Work(WorkType.Status, i)) for i in range(5)]
+        assert ok == [True, True, False, False, False]
+        assert p.dropped[WorkType.Status] == 3
 
     def test_queue_lengths_scale_with_validators(self):
         ql = QueueLengths.from_active_validators(1_000_000)

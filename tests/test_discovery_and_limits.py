@@ -635,3 +635,104 @@ def test_flooding_peer_throttled_then_dropped_honest_unaffected():
         a.stop()
         flooder.stop()
         honest.stop()
+
+
+# ---------------------------------------------------------------------------
+# Rate-limiter bucket pruning (serve-loop growth bound)
+# ---------------------------------------------------------------------------
+
+
+def test_rate_limiter_prune_is_time_gated_and_bounds_growth():
+    now = [0.0]
+    rl = RateLimiter(clock=lambda: now[0])
+    # the gate starts CLOSED (no prune churn on a fresh limiter) and opens
+    # at most once per max_age
+    assert not rl.maybe_prune(max_age=60.0)
+    now[0] = 61.0
+    assert rl.maybe_prune(max_age=60.0)
+    assert not rl.maybe_prune(max_age=60.0)
+    now[0] = 0.0
+    rl = RateLimiter(clock=lambda: now[0])
+    # a long churn walk: 500 one-shot peers, two methods each, with
+    # maybe_prune riding every request exactly like the serve loop does
+    for i in range(500):
+        now[0] += 0.5
+        rl.allow(f"peer-{i}:9000", "status")
+        rl.allow(f"peer-{i}:9000", "metadata")
+        rl.maybe_prune(max_age=60.0)
+    # without pruning this map holds 1000 buckets; the time-gated prune
+    # keeps at most ~2 gate-periods of live peers (2 buckets each)
+    assert len(rl._buckets) <= 2 * int(2 * 60.0 / 0.5)
+    # idle buckets are gone, recent ones survive
+    assert ("peer-0:9000", "status") not in rl._buckets
+    assert ("peer-499:9000", "status") in rl._buckets
+
+
+def test_transport_serve_loop_prunes_idle_buckets():
+    from lighthouse_tpu.network.transport import Status
+
+    spec = minimal_spec()
+    a = _transport(spec)
+    b = _transport(spec)
+    try:
+        now = [0.0]
+        b.rate_limiter = RateLimiter(clock=lambda: now[0])
+        a.dial(b.local_addr)
+        assert _wait_for(lambda: b.local_addr in a.peers())
+        st = Status(b"\x00" * 4, b"\x00" * 32, 0, b"\x00" * 32, 0)
+        a.request(a.local_addr, b.local_addr, "status", st)
+        assert ("status" in {m for _, m in b.rate_limiter._buckets})
+        # every bucket goes idle far past max_age; the NEXT served request
+        # triggers the serve-loop prune before spending tokens
+        now[0] = 1000.0
+        a.request(a.local_addr, b.local_addr, "blocks_by_root",
+                  [b"\x00" * 32])
+        keys = set(b.rate_limiter._buckets)
+        assert all(m != "status" for _, m in keys), (
+            "serve loop never pruned the idle status bucket"
+        )
+        assert any(m == "blocks_by_root" for _, m in keys)
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Ban expiry: forgiveness score + faster re-ban for recidivists
+# ---------------------------------------------------------------------------
+
+
+def test_ban_expiry_resets_score_and_rebans_faster():
+    from lighthouse_tpu.network.peer_manager import BAN_DURATION
+
+    now = [0.0]
+    pm = PeerManager(clock=lambda: now[0])
+    assert pm.on_connect("9.9.9.9:9000")
+    # first offence ladder: -20 per rate-limit refusal, five to the ban
+    first = 0
+    while not pm.is_banned(addr="9.9.9.9:9000"):
+        pm.report("9.9.9.9:9000", -20.0)
+        first += 1
+    assert first == 5
+    assert pm.state("9.9.9.9:9000") == "banned"
+    # still banned just before expiry, forgiven just after
+    now[0] = BAN_DURATION - 1.0
+    assert pm.is_banned(addr="9.9.9.9:9000")
+    now[0] = BAN_DURATION + 1.0
+    assert not pm.is_banned(addr="9.9.9.9:9000")
+    # forgiveness is NOT a clean slate: the score resets to half the
+    # threshold, so a recidivist re-bans in fewer offences
+    assert pm.score("9.9.9.9:9000") == BAN_THRESHOLD / 2
+    assert pm.state("9.9.9.9:9000") == "disconnected"
+    assert pm.on_connect("9.9.9.9:9000")
+    again = 0
+    while not pm.is_banned(addr="9.9.9.9:9000"):
+        pm.report("9.9.9.9:9000", -20.0)
+        again += 1
+    assert again == 3
+    assert again < first
+    # the re-ban starts a fresh BAN_DURATION window
+    now[0] += BAN_DURATION - 1.0
+    assert pm.is_banned(addr="9.9.9.9:9000")
+    now[0] += 2.0
+    assert not pm.is_banned(addr="9.9.9.9:9000")

@@ -1,0 +1,570 @@
+"""Overload-protection tier: deadline propagation, admission control,
+adaptive timeouts/backoff/self-limiting, and the shedding surfaces.
+
+Layered like the subsystem itself:
+
+* pure-policy units (deadline budgets, RFC 6298 estimator, backoff,
+  self-limiter) run on manual clocks — no sleeps, no sockets;
+* LoadMonitor folding (fill / drops / lag / ladder state, fail-closed on
+  a raising source and on the ``loadshed.monitor_sample`` injection stage);
+* the beacon-processor deadline gates (expired at submit, expired at
+  dispatch, LIFO overflow dropping the OLDEST item) and the firehose's
+  expiry + end-to-end latency accounting;
+* the two shedding surfaces over real transports: the HTTP API's 503 +
+  Retry-After gate (P0 routes always admitted) and Req/Resp shedding of
+  lowest-priority methods, plus the adaptive per-peer timeout learning a
+  real RTT and the server-side request-expiry answer.
+"""
+
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    QueueLengths,
+    Work,
+    WorkType,
+)
+from lighthouse_tpu.firehose import (
+    AdaptiveBatcher,
+    FirehoseConfig,
+    FirehoseEngine,
+    FirehoseItem,
+)
+from lighthouse_tpu.loadshed import (
+    AdmissionLevel,
+    BackoffPolicy,
+    LoadMonitor,
+    LoadThresholds,
+    RttEstimator,
+    SelfLimiter,
+    budget_for,
+    deadline_for,
+    expired,
+    is_p0_route,
+    method_priority,
+    should_shed_method,
+)
+from lighthouse_tpu.resilience import injector
+
+
+# -- deadline budgets --------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_slot_scaled_budgets(self):
+        # one inclusion slot for gossip attestations, scaled by slot time
+        assert budget_for(WorkType.GossipAttestation) == 12.0
+        assert budget_for(WorkType.GossipAttestation, slot_seconds=6.0) == 6.0
+        assert budget_for(WorkType.UnknownBlockAttestation) == 24.0
+
+    def test_blocks_never_expire(self):
+        assert budget_for(WorkType.GossipBlock) is None
+        assert deadline_for(WorkType.GossipBlock) is None
+
+    def test_flat_rpc_budgets(self):
+        assert budget_for(WorkType.Status) == 10.0
+        assert budget_for(WorkType.BlocksByRangeRequest) == 10.0
+
+    def test_deadline_and_expiry(self):
+        d = deadline_for(WorkType.GossipAttestation, now=100.0)
+        assert d == 112.0
+        assert not expired(d, now=111.9)
+        assert expired(d, now=112.1)
+        assert not expired(None, now=1e12)  # no deadline never expires
+
+
+# -- RTT estimator (RFC 6298) ------------------------------------------------------
+
+
+class TestRttEstimator:
+    def test_ceiling_before_any_sample(self):
+        est = RttEstimator(max_timeout=10.0)
+        assert est.timeout() == 10.0
+
+    def test_converges_to_observed_rtt(self):
+        est = RttEstimator(min_timeout=0.05, max_timeout=10.0)
+        for _ in range(16):
+            est.observe(0.02)
+        # srtt ~0.02, rttvar -> 0: timeout collapses far below the ceiling
+        assert est.timeout() < 0.5
+        assert est.timeout() >= est.min_timeout
+
+    def test_timeout_backoff_inflates_until_fresh_sample(self):
+        est = RttEstimator(min_timeout=0.01, max_timeout=100.0)
+        for _ in range(8):
+            est.observe(0.1)
+        base = est.timeout()
+        est.on_timeout()
+        assert est.timeout() == pytest.approx(base * 2.0)
+        for _ in range(10):
+            est.on_timeout()
+        # inflation is capped at 16x
+        assert est.timeout() <= base * 16.0 + 1e-9
+        est.observe(0.1)  # a fresh sample resets the inflation
+        assert est.timeout() < base * 2.0
+
+    def test_variance_widens_timeout(self):
+        steady = RttEstimator(max_timeout=100.0)
+        jittery = RttEstimator(max_timeout=100.0)
+        for i in range(32):
+            steady.observe(0.1)
+            jittery.observe(0.02 if i % 2 else 0.18)  # same mean, wild var
+        assert jittery.timeout() > steady.timeout()
+
+
+# -- backoff policy ----------------------------------------------------------------
+
+
+class TestBackoffPolicy:
+    def _policy(self, now, **kw):
+        kw.setdefault("seed", 7)
+        return BackoffPolicy(clock=lambda: now[0], **kw)
+
+    def test_cooldown_grows_and_expires(self):
+        now = [0.0]
+        bp = self._policy(now, base=1.0, factor=2.0, jitter=0.0)
+        assert bp.ready("p")
+        assert bp.record_failure("p") == 1.0
+        assert not bp.ready("p")
+        assert bp.record_failure("p") == 2.0  # exponential growth
+        assert bp.failures("p") == 2
+        now[0] = 1.0 + 2.0 + 0.01  # past the second cooldown
+        assert bp.ready("p")
+
+    def test_cooldown_is_capped(self):
+        now = [0.0]
+        bp = self._policy(now, base=1.0, factor=10.0, cooldown_cap=5.0,
+                          jitter=0.0)
+        for _ in range(6):
+            d = bp.record_failure("p")
+        assert d == 5.0
+
+    def test_success_resets(self):
+        now = [0.0]
+        bp = self._policy(now, base=1.0, jitter=0.0)
+        bp.record_failure("p")
+        bp.record_success("p")
+        assert bp.ready("p")
+        assert bp.failures("p") == 0
+        # and the next failure starts the ladder over
+        assert bp.record_failure("p") == 1.0
+
+    def test_jitter_is_seeded_and_bounded(self):
+        a = BackoffPolicy(base=1.0, jitter=0.5, seed=42,
+                          clock=lambda: 0.0)
+        b = BackoffPolicy(base=1.0, jitter=0.5, seed=42,
+                          clock=lambda: 0.0)
+        da, db = a.record_failure("p"), b.record_failure("p")
+        assert da == db  # same seed -> same jitter draw
+        assert 0.5 <= da <= 1.0  # full-jitter lower half
+
+    def test_attempt_delay_ladder(self):
+        bp = BackoffPolicy(base=0.2, factor=2.0, max_attempt_delay=1.0,
+                           jitter=0.0, seed=1)
+        assert bp.attempt_delay(0) == 0.0  # first attempt is free
+        assert bp.attempt_delay(1) == pytest.approx(0.2)
+        assert bp.attempt_delay(2) == pytest.approx(0.4)
+        assert bp.attempt_delay(10) == pytest.approx(1.0)  # capped
+
+
+# -- self-limiter ------------------------------------------------------------------
+
+
+class TestSelfLimiter:
+    def test_paces_below_the_shadow_quota(self):
+        from lighthouse_tpu.network.rate_limiter import Quota
+
+        now = [0.0]
+        sl = SelfLimiter(quotas={"status": Quota(10, 10.0)}, margin=0.9,
+                         clock=lambda: now[0])
+        # margin 0.9 on a 10-token quota leaves 9 local tokens
+        for _ in range(9):
+            assert sl.throttle("peer", "status") == 0.0
+        wait = sl.throttle("peer", "status")
+        assert wait > 0.0
+        now[0] += wait + 0.01  # the wait it quoted is exactly enough
+        assert sl.throttle("peer", "status") == 0.0
+
+    def test_default_quotas_shadow_the_server(self):
+        sl = SelfLimiter()  # DEFAULT_QUOTAS scaled by the margin
+        assert sl.throttle("peer", "status") == 0.0
+
+
+# -- load monitor ------------------------------------------------------------------
+
+
+class TestLoadMonitor:
+    def _monitor(self, now, **sources):
+        mon = LoadMonitor(clock=lambda: now[0])
+        for name, fn in sources.items():
+            mon.add_source(name, fn)
+        return mon
+
+    def test_levels_from_fill(self):
+        now = [0.0]
+        reading = {"fill": 0.0}
+        mon = self._monitor(now, q=lambda: reading)
+        assert mon.sample() is AdmissionLevel.HEALTHY
+        reading["fill"] = 0.6
+        assert mon.sample() is AdmissionLevel.BUSY
+        reading["fill"] = 0.95
+        assert mon.sample() is AdmissionLevel.SATURATED
+        reading["fill"] = 0.1
+        assert mon.sample() is AdmissionLevel.HEALTHY
+
+    def test_windowed_drops_escalate_and_recover(self):
+        now = [0.0]
+        reading = {"submitted": 0, "dropped": 0}
+        mon = self._monitor(now, q=lambda: reading)
+        assert mon.sample() is AdmissionLevel.HEALTHY
+        # a burst of drops in the window: BUSY (any) or SATURATED (rate)
+        reading.update(submitted=100, dropped=1)
+        assert mon.sample() is AdmissionLevel.BUSY
+        reading.update(submitted=110, dropped=11)  # 50% of the new window
+        assert mon.sample() is AdmissionLevel.SATURATED
+        # window moves on with no NEW drops: back to healthy
+        reading.update(submitted=200, dropped=11)
+        assert mon.sample() is AdmissionLevel.HEALTHY
+
+    def test_worker_lag_and_ladder_state(self):
+        now = [0.0]
+        reading = {}
+        mon = self._monitor(now, q=lambda: reading)
+        reading["lag_s"] = 2.0
+        assert mon.sample() is AdmissionLevel.BUSY
+        reading["lag_s"] = 5.0
+        assert mon.sample() is AdmissionLevel.SATURATED
+        reading.clear()
+        reading["degraded"] = True
+        assert mon.sample() is AdmissionLevel.BUSY
+        reading["quarantined"] = True
+        assert mon.sample() is AdmissionLevel.SATURATED
+
+    def test_level_caches_within_sample_interval(self):
+        now = [0.0]
+        reading = {"fill": 0.0}
+        mon = self._monitor(now, q=lambda: reading)
+        assert mon.level() is AdmissionLevel.HEALTHY
+        reading["fill"] = 1.0
+        # same instant: cached, no resample
+        assert mon.level() is AdmissionLevel.HEALTHY
+        now[0] += LoadThresholds().min_sample_interval + 0.01
+        assert mon.level() is AdmissionLevel.SATURATED
+
+    def test_raising_source_fails_closed(self):
+        now = [0.0]
+
+        def bad():
+            raise RuntimeError("source wedged")
+
+        mon = self._monitor(now, q=bad)
+        assert mon.sample() is AdmissionLevel.SATURATED
+        assert mon.summary()["sample_failures"] == 1
+
+    def test_injected_sample_fault_fails_closed(self):
+        now = [0.0]
+        mon = self._monitor(now, q=lambda: {"fill": 0.0})
+        injector.install(
+            "stage=loadshed.monitor_sample;mode=raise;kind=transient;at=1"
+        )
+        try:
+            assert mon.sample() is AdmissionLevel.SATURATED
+            # fault was one-shot: the next sample sees the true (idle) load
+            assert mon.sample() is AdmissionLevel.HEALTHY
+        finally:
+            injector.clear()
+
+    def test_transitions_recorded_and_forced(self):
+        now = [0.0]
+        reading = {"fill": 0.0}
+        mon = self._monitor(now, q=lambda: reading)
+        mon.sample()
+        reading["fill"] = 0.95
+        mon.sample()
+        reading["fill"] = 0.0
+        mon.sample()
+        names = [(f, t) for _, f, t in mon.transitions()]
+        assert ("HEALTHY", "SATURATED") in names
+        assert ("SATURATED", "HEALTHY") in names
+        mon.force_level(AdmissionLevel.SATURATED)
+        reading["fill"] = 0.0
+        assert mon.level() is AdmissionLevel.SATURATED  # pinned
+        mon.force_level(None)
+        assert mon.sample() is AdmissionLevel.HEALTHY
+
+    def test_attach_processor_source(self):
+        ql = QueueLengths(overrides={WorkType.GossipAttestation: 4})
+        proc = BeaconProcessor(
+            BeaconProcessorConfig(queue_lengths=ql), synchronous=False
+        )
+        proc.shutdown()
+        now = [0.0]
+        mon = LoadMonitor(clock=lambda: now[0])
+        mon.attach_processor(proc)
+        assert mon.sample() is AdmissionLevel.HEALTHY
+        for i in range(4):  # fill the attestation queue to capacity
+            proc.submit(Work(WorkType.GossipAttestation, i,
+                             process_individual=lambda x: None))
+        assert mon.sample() is AdmissionLevel.SATURATED
+
+
+# -- beacon processor deadline gates -----------------------------------------------
+
+
+class TestProcessorDeadlines:
+    def _proc(self, **kw):
+        p = BeaconProcessor(BeaconProcessorConfig(**kw), synchronous=False)
+        p.shutdown()  # manual drain
+        return p
+
+    def test_expired_at_submit_is_refused(self):
+        p = self._proc()
+        done = []
+        w = Work(WorkType.GossipAttestation, "stale",
+                 process_individual=done.append,
+                 deadline=time.monotonic() - 1.0)
+        assert not p.submit(w)
+        assert p.expired[WorkType.GossipAttestation] == 1
+        p.run_until_idle()
+        assert done == []
+
+    def test_expired_at_dispatch_is_shed_before_the_handler(self):
+        p = self._proc()
+        done = []
+        now = time.monotonic()
+        p.submit(Work(WorkType.GossipAttestation, "soon-stale",
+                      process_individual=done.append,
+                      deadline=now + 0.05))
+        p.submit(Work(WorkType.GossipAttestation, "fresh",
+                      process_individual=done.append,
+                      deadline=now + 60.0))
+        time.sleep(0.1)  # the first deadline passes while queued
+        p.run_until_idle()
+        assert done == ["fresh"]
+        assert p.expired[WorkType.GossipAttestation] == 1
+        assert p.processed[WorkType.GossipAttestation] == 1
+
+    def test_lifo_overflow_drops_oldest_and_counts(self):
+        from lighthouse_tpu.utils.metrics import PROCESSOR_OVERFLOW_DROPS
+
+        def metric_value():
+            for key, _, v in PROCESSOR_OVERFLOW_DROPS.collect():
+                if key == (WorkType.GossipAttestation.name,):
+                    return v
+            return 0.0
+
+        ql = QueueLengths(overrides={WorkType.GossipAttestation: 2})
+        p = self._proc(queue_lengths=ql, max_batch_size=8)
+        before = metric_value()
+        done = []
+        for i in range(3):
+            assert p.submit(Work(WorkType.GossipAttestation, i,
+                                 process_individual=done.append))
+        assert p.dropped[WorkType.GossipAttestation] == 1
+        assert metric_value() == before + 1
+        p.run_until_idle()
+        # the OLDEST item (0) was evicted; the fresh arrival was admitted
+        assert sorted(done) == [1, 2]
+
+    def test_fifo_overflow_refuses_the_arrival(self):
+        ql = QueueLengths(overrides={WorkType.Status: 1})
+        p = self._proc(queue_lengths=ql)
+        assert p.submit(Work(WorkType.Status, "a",
+                             process_individual=lambda x: None))
+        assert not p.submit(Work(WorkType.Status, "b",
+                                 process_individual=lambda x: None))
+        assert p.dropped[WorkType.Status] == 1
+
+
+# -- firehose expiry + end-to-end latency ------------------------------------------
+
+
+class TestFirehoseDeadlines:
+    def test_batcher_sheds_expired_at_form_time(self):
+        b = AdaptiveBatcher(FirehoseConfig(max_batch=4, deadline_s=0.001,
+                                           intake_capacity=16))
+        now = time.monotonic()
+        expired_cb = []
+        b.submit(FirehoseItem(WorkType.GossipAttestation, "stale",
+                              callback=lambda p, ok, meta=None:
+                              expired_cb.append((p, ok)),
+                              deadline=now - 1.0))
+        b.submit(FirehoseItem(WorkType.GossipAttestation, "fresh",
+                              deadline=now + 60.0))
+        batch = b.next_batch(timeout=0.5)
+        assert [it.payload for it in batch] == ["fresh"]
+        assert b.expired_total == 1
+        # the expired item's callback got a negative verdict, outside a lock
+        assert expired_cb == [("stale", False)]
+
+    def test_engine_reports_e2e_percentiles_from_wire_ingest(self):
+        engine = FirehoseEngine(
+            prepare_fn=lambda ps: [([(p,)], None) for p in ps],
+            verify_items_fn=lambda items: True,
+            config=FirehoseConfig(max_batch=4, deadline_s=0.005,
+                                  intake_capacity=64),
+        )
+        try:
+            t0 = time.monotonic()
+            for i in range(8):
+                # wire ingest 50ms ago: e2e must dominate intake latency
+                assert engine.submit(i, ingest_at=t0 - 0.05,
+                                     deadline=t0 + 60.0)
+            assert engine.flush(timeout=10.0)
+        finally:
+            engine.stop(drain_timeout=10.0)
+        st = engine.stats()
+        assert st.verified == 8
+        assert st.expired == 0
+        assert st.p50_e2e_s is not None and st.p50_e2e_s >= 0.05
+        assert st.p99_e2e_s >= st.p50_e2e_s
+        # e2e (from the wire) strictly dominates intake-to-verdict latency
+        assert st.p50_e2e_s > (st.p50_latency_s or 0.0)
+
+
+# -- shedding surfaces over real transports ----------------------------------------
+
+
+class _StubHead:
+    slot = 0
+
+
+class _StubChain:
+    """Just enough chain for the probed routes: version is pure, syncing
+    reads only head.slot / current_slot / execution_layer."""
+
+    import threading as _threading
+
+    lock = _threading.Lock()
+    head = _StubHead()
+    execution_layer = None
+
+    def current_slot(self):
+        return 0
+
+
+class TestHttpAdmissionGate:
+    def test_p1_shed_with_retry_after_p0_always_admitted(self):
+        from lighthouse_tpu.http_api import BeaconApiServer
+
+        assert not is_p0_route("version")
+        assert is_p0_route("syncing")
+        mon = LoadMonitor()
+        api = BeaconApiServer(_StubChain(), load_monitor=mon).start()
+        try:
+            # healthy: both admitted
+            with urllib.request.urlopen(api.url + "/eth/v1/node/version",
+                                        timeout=5) as r:
+                assert r.status == 200
+            mon.force_level(AdmissionLevel.SATURATED)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(api.url + "/eth/v1/node/version",
+                                       timeout=5)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "1"
+            # P0 duty route: always admitted, even SATURATED
+            with urllib.request.urlopen(api.url + "/eth/v1/node/syncing",
+                                        timeout=5) as r:
+                assert r.status == 200
+            mon.force_level(None)
+            with urllib.request.urlopen(api.url + "/eth/v1/node/version",
+                                        timeout=5) as r:
+                assert r.status == 200
+        finally:
+            api.stop()
+
+
+class TestReqRespOverload:
+    """Transport-level shedding, adaptive timeouts, server-side expiry."""
+
+    @staticmethod
+    def _status():
+        from lighthouse_tpu.network.transport import Status
+
+        return Status(b"\x00" * 4, b"\x00" * 32, 0, b"\x00" * 32, 0)
+
+    def _pair(self):
+        from lighthouse_tpu.network.socket_transport import SocketTransport
+        from lighthouse_tpu.types.spec import minimal_spec
+
+        class _Svc:
+            def on_gossip(self, *a):
+                pass
+
+            def on_rpc(self, method, payload, from_peer):
+                from lighthouse_tpu.network.transport import Status
+
+                if method == "status":
+                    return Status(b"\x00" * 4, b"\x00" * 32, 0,
+                                  b"\x00" * 32, 0)
+                return []
+
+        spec = minimal_spec()
+        a = SocketTransport(spec, rpc_timeout=2.0)
+        a.register(a.local_addr, _Svc())
+        b = SocketTransport(spec, rpc_timeout=2.0)
+        b.register(b.local_addr, _Svc())
+        assert a.dial(b.local_addr)
+        deadline = time.monotonic() + 5.0
+        while b.local_addr not in a.peers():
+            assert time.monotonic() < deadline, "dial never completed"
+            time.sleep(0.02)
+        return a, b
+
+    def test_saturated_server_sheds_bulk_methods_not_status(self):
+        assert should_shed_method("blocks_by_range",
+                                  AdmissionLevel.SATURATED)
+        assert not should_shed_method("status", AdmissionLevel.SATURATED)
+        assert method_priority("status") == 0
+
+        a, b = self._pair()
+        try:
+            mon = LoadMonitor()
+            mon.force_level(AdmissionLevel.SATURATED)
+            b.load_monitor = mon
+            with pytest.raises(ConnectionError, match="overloaded"):
+                a.request(a.local_addr, b.local_addr,
+                          "blocks_by_range", (0, 4))
+            # highest-priority method still answered under saturation
+            assert a.request(a.local_addr, b.local_addr, "status",
+                             self._status()) is not None
+            # shedding carries no score penalty: OUR load, not their fault
+            assert b.peer_scores().get(a.local_addr, 0.0) >= 0.0
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_adaptive_timeout_learns_the_rtt(self):
+        from lighthouse_tpu.network.rate_limiter import Quota
+
+        a, b = self._pair()
+        try:
+            # widen the server's status quota: this test measures RTTs, not
+            # rate limiting (the default is 5 per 15s)
+            b.rate_limiter.quotas["status"] = Quota(100, 15.0)
+            assert a.peer_timeout(b.local_addr) == 2.0  # ceiling, no samples
+            for _ in range(8):
+                a.request(a.local_addr, b.local_addr, "status",
+                          self._status())
+            # loopback RTTs are sub-millisecond: the learned timeout must
+            # collapse far below the 2s ceiling
+            assert a.peer_timeout(b.local_addr) < 1.0
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_server_side_expiry_answers_error_not_work(self):
+        a, b = self._pair()
+        try:
+            b.server_deadline_s = -1.0  # every request is already late
+            with pytest.raises(ConnectionError, match="expired"):
+                a.request(a.local_addr, b.local_addr, "status",
+                          self._status())
+        finally:
+            a.stop()
+            b.stop()

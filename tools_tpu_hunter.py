@@ -59,6 +59,7 @@ LOG = os.path.join(ROOT, "TPU_WINDOW_LOG.jsonl")
 STATE = os.path.join(CACHE, "hunter_state.json")
 RECORD = os.path.join(CACHE, "tpu_record.json")
 RECORD_FIREHOSE = os.path.join(CACHE, "tpu_firehose_record.json")
+RECORD_OVERLOAD = os.path.join(CACHE, "tpu_overload_record.json")
 RECORD_FIREHOSE_SHARDED = os.path.join(
     CACHE, "tpu_firehose_sharded_record.json"
 )
@@ -135,6 +136,12 @@ RUNGS.insert(3, bench._KZG_CELLS_RUNG_SMALL)
 # batch, the host-loop twin rate, and the lc_device resilience stamp.
 # Starts only behind the bench-main flock marker check in main().
 RUNGS.insert(4, bench._LIGHT_CLIENTS_RUNG_SMALL)
+# sustained-abuse overload rung (ISSUE 18): the firehose verify program is
+# already compile-warm from the firehose rung, so this rung spends its
+# window on the overload measurement (honest stream + 10x malformed flood
+# + the in-rung admission-control HTTP probe). Its record carries the
+# admission transitions, shed-by-priority counts and the resilience stamp.
+RUNGS.insert(5, bench._OVERLOAD_RUNG)
 RUNGS.append(bench._EPOCH_RUNG_FULL)
 RUNGS.append(bench._EPOCH_SHARDED_RUNG_FULL)
 RUNGS.append(bench._SLASHER_RUNG_FULL)
@@ -287,6 +294,7 @@ def persist(rec: dict, rung_idx: int) -> None:
     sharded = bool(rec.get("sharded")) or (rec.get("n_devices") or 1) > 1
     record_path = {
         ("firehose_attestations_verified_per_s", False): RECORD_FIREHOSE,
+        ("overload_honest_atts_per_s", False): RECORD_OVERLOAD,
         ("firehose_attestations_verified_per_s", True):
             RECORD_FIREHOSE_SHARDED,
         ("epoch_validators_per_s", False): RECORD_EPOCH,
