@@ -1496,32 +1496,77 @@ def _inner_pairing():
     )
 
 
-def _build_epoch_state(spec, n: int, rng):
-    """Synthetic mainnet-preset altair state with ``n`` validators for the
-    epoch-replay rung (BASELINE config #4). Dummy pubkeys: epoch processing
-    never reads them (the bench epoch avoids the sync-committee rotation
-    boundary, like any non-boundary mainnet epoch)."""
+def _refill_epoch_deposits(state, rng, count: int = 8) -> None:
+    """Top the electra pending-deposit queue back up to ``count`` top-up
+    entries (known pubkeys, so both paths take the scatter-add lane). Keeps
+    every steady-state bench epoch doing real deposit-sweep work instead of
+    draining the queue on the warmup epoch."""
+    from lighthouse_tpu.types.containers import for_preset
+
+    ns = for_preset("mainnet")
+    n = len(state.validators)
+    pending = list(state.pending_deposits)
+    while len(pending) < count:
+        i = int(rng.integers(0, n))
+        pending.append(
+            ns.PendingDeposit(
+                pubkey=bytes(state.validators[i].pubkey),
+                withdrawal_credentials=bytes(
+                    state.validators[i].withdrawal_credentials
+                ),
+                amount=10**9,
+                signature=b"\x00" * 96,
+                slot=1,
+            )
+        )
+    state.pending_deposits = pending
+
+
+def _build_epoch_state(spec, n: int, rng, fork: str = "electra"):
+    """Synthetic mainnet-preset state with ``n`` validators for the
+    epoch-replay rung (BASELINE config #4). Electra (the production fork)
+    by default; ``fork="altair"`` keeps the pre-electra A/B shape.
+
+    Electra states carry UNIQUE per-validator pubkeys: the device engine
+    resolves pending-deposit pubkeys through the mirror's dict map (last
+    occurrence wins) while the numpy twin linear-scans (first occurrence
+    wins), so duplicate dummy keys would silently diverge the in-rung
+    parity gate. Altair epoch processing never reads pubkeys (the bench
+    epoch avoids the sync-committee rotation boundary, like any
+    non-boundary mainnet epoch), so the shared dummy key stays."""
     from lighthouse_tpu.types.containers import Checkpoint, Validator, for_preset
     from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH
 
     ns = for_preset(spec.preset.name)
     p = spec.preset
-    state = ns.BeaconStateAltair()
+    electra = fork == "electra"
+    state = ns.BeaconStateElectra() if electra else ns.BeaconStateAltair()
     # epoch 101: (102 % EPOCHS_PER_ETH1_VOTING_PERIOD=64) != 0 and
     # (102 % EPOCHS_PER_SYNC_COMMITTEE_PERIOD=256) != 0 — no host-side
     # eth1/sync/historical boundary work pollutes the validator-axis number
     cur_epoch = 101
     state.slot = (cur_epoch + 1) * p.SLOTS_PER_EPOCH - 1
     pk = b"\x00" * 48
-    wc = b"\x00" * 32
     far = FAR_FUTURE_EPOCH
     eff = np.full(n, 32 * 10**9, dtype=np.uint64)
     # a realistic trickle of ejectable validators (a storm would make the
     # numpy baseline quadratic in initiate_validator_exit's registry scans
     # — real epochs eject at most a handful)
     eff[rng.choice(n, size=min(32, n // 64), replace=False)] = 15 * 10**9
+    # electra credential mix: ~1/16 compounding (0x02) rows exercise the
+    # per-validator max-effective plane; the rest split 0x01/0x00
+    creds = np.zeros(n, dtype=np.uint8)
+    if electra:
+        creds = rng.integers(0, 16, n).astype(np.uint8)
     validators = []
     for i in range(n):
+        if electra:
+            pk = i.to_bytes(48, "little")
+            wc = (
+                b"\x02" if creds[i] == 0 else b"\x01" if creds[i] < 8 else b"\x00"
+            ) + b"\x00" * 31
+        else:
+            wc = b"\x00" * 32
         validators.append(
             Validator(
                 pubkey=pk,
@@ -1551,17 +1596,94 @@ def _build_epoch_state(spec, n: int, rng):
         epoch=cur_epoch - 1, root=rng.bytes(32)
     )
     state.justification_bits = np.array([1, 1, 1, 1], dtype=bool)
+    if electra:
+        # EIP-6110 bridge done: every pending deposit clears the
+        # transition gate and the sweep's churn budget does the gating
+        state.eth1_deposit_index = n
+        state.deposit_requests_start_index = 0
+        state.deposit_balance_to_consume = 0
+        state.earliest_exit_epoch = 0
+        state.exit_balance_to_consume = 0
+        _refill_epoch_deposits(state, rng)
+        # constant-shape consolidation queue: every entry's source is
+        # un-exited (withdrawable FAR), so the ordered scan stops at entry
+        # 0 each epoch — steady per-epoch scan work, no queue drain
+        state.pending_consolidations = [
+            ns.PendingConsolidation(
+                source_index=int(rng.integers(0, n)),
+                target_index=int(rng.integers(0, n)),
+            )
+            for _ in range(4)
+        ]
     return state
+
+
+def _assert_epoch_parity(dev, twin, fork: str) -> None:
+    """In-rung device-vs-numpy parity gate (ISSUE 19): a record whose sweep
+    diverged from per_epoch.py is not a performance number, it is a bug.
+    Compares the epoch-mutable planes (balances / inactivity / registry
+    epochs) and the electra churn carries + queue shapes; participation and
+    tree roots are excluded (the bench refreshes participation with fresh
+    randomness and full tree hashing at rung scale would dominate the
+    window)."""
+    assert np.array_equal(
+        np.asarray(dev.balances, dtype=np.uint64),
+        np.asarray(twin.balances, dtype=np.uint64),
+    ), "epoch parity: balances diverged"
+    assert np.array_equal(
+        np.asarray(dev.inactivity_scores, dtype=np.uint64),
+        np.asarray(twin.inactivity_scores, dtype=np.uint64),
+    ), "epoch parity: inactivity scores diverged"
+    assert len(dev.validators) == len(twin.validators), (
+        "epoch parity: registry length diverged"
+    )
+    for attr in (
+        "effective_balance",
+        "exit_epoch",
+        "withdrawable_epoch",
+        "activation_epoch",
+        "activation_eligibility_epoch",
+    ):
+        a = np.fromiter(
+            (int(getattr(v, attr)) for v in dev.validators), dtype=np.uint64
+        )
+        b = np.fromiter(
+            (int(getattr(v, attr)) for v in twin.validators), dtype=np.uint64
+        )
+        assert np.array_equal(a, b), f"epoch parity: validator {attr} diverged"
+    assert int(dev.finalized_checkpoint.epoch) == int(
+        twin.finalized_checkpoint.epoch
+    ), "epoch parity: finality diverged"
+    if fork == "electra":
+        for attr in (
+            "deposit_balance_to_consume",
+            "exit_balance_to_consume",
+            "earliest_exit_epoch",
+        ):
+            assert int(getattr(dev, attr)) == int(getattr(twin, attr)), (
+                f"epoch parity: {attr} diverged"
+            )
+        assert len(dev.pending_deposits) == len(twin.pending_deposits), (
+            "epoch parity: pending_deposits queue diverged"
+        )
+        assert len(dev.pending_consolidations) == len(
+            twin.pending_consolidations
+        ), "epoch parity: pending_consolidations queue diverged"
 
 
 def _inner_epoch():
     """Epoch-engine rung (BASELINE.json config #4, the 1M-validator epoch
-    replay): advance a synthetic mainnet-shape altair state across epoch
+    replay): advance a synthetic mainnet-shape state across epoch
     boundaries through the DEVICE epoch engine (lighthouse_tpu/epoch_engine)
     and report validators/sec, ms/epoch and the host<->device delta-update
-    traffic. The numpy per_epoch.py path at the same shape is the baseline
-    (skipped at the million-validator rung, where the object gather alone
-    takes minutes — the engine existing is the point)."""
+    traffic. Electra (the production fork: pending-deposit scatter +
+    consolidation scan + per-validator max-effective plane) by default;
+    BENCH_EPOCH_FORK=altair keeps the pre-electra A/B shape. The numpy
+    per_epoch.py path at the same shape is the baseline AND the in-rung
+    parity gate — the twin's epoch must agree with the device sweep
+    field-for-field before the timed loop counts (skipped at the
+    million-validator rung, where the object gather alone takes minutes —
+    the engine existing is the point)."""
     _enable_compile_cache()
     fallback = os.environ.get("BENCH_FALLBACK") == "1"
     if fallback:
@@ -1590,17 +1712,38 @@ def _inner_epoch():
             np.array(jax.devices()[:n_dev]), axis_names=("validators",)
         )
         sharding = NamedSharding(mesh, PartitionSpec("validators"))
-    spec = mainnet_spec(altair_fork_epoch=0)
+    fork = os.environ.get("BENCH_EPOCH_FORK", "electra")
+    if fork == "electra":
+        spec = mainnet_spec(
+            altair_fork_epoch=0,
+            bellatrix_fork_epoch=0,
+            capella_fork_epoch=0,
+            deneb_fork_epoch=0,
+            electra_fork_epoch=0,
+        )
+    else:
+        spec = mainnet_spec(altair_fork_epoch=0)
     rng = np.random.default_rng(0xE9_0C)
     t0 = time.perf_counter()
-    state = _build_epoch_state(spec, n, rng)
-    print(f"# built {n}-validator state in {time.perf_counter() - t0:.0f}s",
-          flush=True)
+    state = _build_epoch_state(spec, n, rng, fork=fork)
+    print(f"# built {n}-validator {fork} state in "
+          f"{time.perf_counter() - t0:.0f}s", flush=True)
 
     epoch_engine.set_backend("device")
     if sharding is not None:
         epoch_engine.prepare_state(state, sharding=sharding)
     per_epoch_slots = spec.preset.SLOTS_PER_EPOCH
+
+    def finish_epoch(s):
+        s.slot += per_epoch_slots
+        # keep participation live so every epoch does real reward work
+        s.current_epoch_participation = rng.integers(0, 8, len(s.validators)).astype(
+            np.uint8
+        )
+        if fork == "electra":
+            # keep the deposit sweep fed: the warmup epoch consumed the
+            # initial queue (8 x 1 ETH top-ups fit one epoch's churn)
+            _refill_epoch_deposits(s, rng)
 
     def one_epoch(s):
         assert epoch_engine.maybe_process_epoch_on_device(
@@ -1608,34 +1751,43 @@ def _inner_epoch():
         ), (
             "epoch engine refused the bench state"
         )
-        s.slot += per_epoch_slots
-        # keep participation live so every epoch does real reward work
-        s.current_epoch_participation = rng.integers(0, 8, len(s.validators)).astype(
-            np.uint8
-        )
+        finish_epoch(s)
 
     t0 = time.perf_counter()
-    one_epoch(state)  # bind mirror + compile
+    # warmup (bind mirror + compile) — held open before the host
+    # bookkeeping so the numpy twin below compares against exactly one
+    # device epoch
+    assert epoch_engine.maybe_process_epoch_on_device(
+        spec, state, sharding=sharding
+    ), "epoch engine refused the bench state"
     print(
         f"# warmup (bind + compile) {time.perf_counter() - t0:.0f}s on "
         f"{platform}",
         flush=True,
     )
+
+    # numpy baseline at the same shape (one epoch; prohibitive at 1M) —
+    # doubles as the in-rung parity gate against the device warmup epoch
+    numpy_v_per_s = None
+    if n <= 262144:
+        epoch_engine.set_backend("numpy")
+        twin = _build_epoch_state(
+            spec, n, np.random.default_rng(0xE9_0C), fork=fork
+        )
+        t0 = time.perf_counter()
+        process_epoch(spec, twin)
+        numpy_dt = time.perf_counter() - t0
+        numpy_v_per_s = n / numpy_dt if numpy_dt else None
+        _assert_epoch_parity(state, twin, fork)
+        print("# in-rung parity: device sweep == numpy twin", flush=True)
+        epoch_engine.set_backend("device")
+    finish_epoch(state)
+
     t0 = time.perf_counter()
     for _ in range(iters):
         one_epoch(state)
     dt = time.perf_counter() - t0
     stats = epoch_engine.engine_stats(state) or {}
-
-    # numpy baseline at the same shape (one epoch; prohibitive at 1M)
-    numpy_v_per_s = None
-    if n <= 262144:
-        epoch_engine.set_backend("numpy")
-        twin = _build_epoch_state(spec, n, np.random.default_rng(0xE9_0C))
-        t0 = time.perf_counter()
-        process_epoch(spec, twin)
-        numpy_dt = time.perf_counter() - t0
-        numpy_v_per_s = n / numpy_dt if numpy_dt else None
 
     ms_per_epoch = dt / iters * 1e3
     value = n * iters / dt
@@ -1656,7 +1808,7 @@ def _inner_epoch():
                 "shape": {
                     "validators": n,
                     "preset": "mainnet",
-                    "fork": "altair",
+                    "fork": fork,
                     "epochs_timed": iters,
                 },
                 "ms_per_epoch": round(ms_per_epoch, 2),
@@ -2189,11 +2341,18 @@ def _hunter_record(mode: str = "sets") -> dict | None:
     # then the freshest capture — the emitted record is self-describing
     # either way (it carries conv_impl + jax_version)
     base = name[: -len(".json")]
-    candidates = [name] + [
-        f"{base}.{impl}.json"
-        for impl in ("pallas", "digits", "f64", "shear", "unstamped",
-                     "unknown")  # _backend_stamp's exception sentinel
-    ]
+    impls = ("pallas", "digits", "f64", "shear", "unstamped",
+             "unknown")  # _backend_stamp's exception sentinel
+    candidates = [name] + [f"{base}.{impl}.json" for impl in impls]
+    # epoch-family records are additionally fork-keyed (ISSUE 19): the
+    # hunter suffixes shape.fork after the conv stamp, and electra (the
+    # production fork) outranks an altair record at the same rung
+    if mode in ("epoch", "epoch_sharded"):
+        candidates += [
+            f"{base}.{impl}.{fork}.json"
+            for impl in impls
+            for fork in ("electra", "altair")
+        ]
     best = []
     for nm in candidates:
         try:
@@ -2207,7 +2366,11 @@ def _hunter_record(mode: str = "sets") -> dict | None:
         return None
     rec = max(
         best,
-        key=lambda r: (r.get("_rung", -1), r.get("captured_at") or ""),
+        key=lambda r: (
+            r.get("_rung", -1),
+            (r.get("shape") or {}).get("fork") == "electra",
+            r.get("captured_at") or "",
+        ),
     )
     rec.pop("_rung", None)
     head = git_head()

@@ -161,6 +161,10 @@ def graph_registry(batch: int) -> list[tuple]:
     e1, e2, e6, e12 = s(25), s(2, 25), s(6, 25), s(12, 25)
     p1, p2 = s(3, 25), s(6, 25)
     sc = jax.ShapeDtypeStruct(B, u64)
+    # epoch-sweep planes: minimum validator-axis bucket + scalar carries
+    _v64 = jax.ShapeDtypeStruct((256,), u64)
+    _vbool = jax.ShapeDtypeStruct((256,), jnp.bool_)
+    _s64 = jax.ShapeDtypeStruct((), u64)
 
     def g(k, f):
         return functools.partial(f, k)
@@ -370,6 +374,46 @@ def graph_registry(batch: int) -> list[tuple]:
              jax.ShapeDtypeStruct((batch * 4,), jnp.bool_),  # valid
              jax.ShapeDtypeStruct((), jnp.int32),            # cur epoch
          )),
+        # epoch_engine/kernels.py — the electra fused epoch sweep
+        # (ISSUE 19): altair head + balance-churned registry updates +
+        # pending-deposit scatter + consolidation scan + the per-validator
+        # max-effective plane. Its obligations (int32 index domain, u64
+        # prefix-sum/slashing headroom, fixed deposit-plane width) are
+        # recorded by the kernel's own trace-time ``fq._cert`` calls. The
+        # registry pins the minimum validator bucket (256); larger buckets
+        # re-assert the same obligations at their own extent on every
+        # runtime compile (the cert values scale with the traced shape).
+        ("epoch.sweep_electra", _epoch_sweep_graph(),
+         (
+             {
+                 "effective": _v64, "slashed": _vbool,
+                 "activation": _v64, "exit": _v64,
+                 "withdrawable": _v64, "eligibility": _v64,
+                 "compounding": _vbool, "balances": _v64,
+                 "inactivity": _v64,
+                 "prev_part": jax.ShapeDtypeStruct((256,), jnp.uint8),
+                 "cur_part": jax.ShapeDtypeStruct((256,), jnp.uint8),
+                 "dep_amount": jax.ShapeDtypeStruct((16,), u64),
+                 "dep_slot": jax.ShapeDtypeStruct((16,), u64),
+                 "dep_index": jax.ShapeDtypeStruct((16,), jnp.int32),
+                 "dep_valid": jax.ShapeDtypeStruct((16,), jnp.bool_),
+                 "con_src": jax.ShapeDtypeStruct((8,), jnp.int32),
+                 "con_tgt": jax.ShapeDtypeStruct((8,), jnp.int32),
+                 "con_valid": jax.ShapeDtypeStruct((8,), jnp.bool_),
+             },
+             {
+                 "cur_epoch": _s64, "finalized_epoch": _s64,
+                 "prev_justified_epoch": _s64,
+                 "cur_justified_epoch": _s64,
+                 "bits": jax.ShapeDtypeStruct((4,), jnp.bool_),
+                 "slash_sum": _s64,
+                 "earliest_exit_epoch": _s64,
+                 "exit_balance_to_consume": _s64,
+                 "deposit_balance_to_consume": _s64,
+                 "eth1_deposit_index": _s64,
+                 "deposit_requests_start_index": _s64,
+             },
+         )),
     ]
 
 
@@ -377,6 +421,21 @@ def _slasher_sweep_graph():
     from ..slasher import kernels as slasher_kernels
 
     return functools.partial(slasher_kernels.sweep_impl, n=64)
+
+
+def _epoch_sweep_graph():
+    from ..epoch_engine import kernels as epoch_kernels
+    from ..types.spec import mainnet_spec
+
+    spec = mainnet_spec(
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+        electra_fork_epoch=0,
+    )
+    consts = epoch_kernels.consts_for(spec, "electra")
+    return functools.partial(epoch_kernels._sweep_electra, consts)
 
 
 # Batch regimes: bound propagation is shape-dependent (broadcast axes reach

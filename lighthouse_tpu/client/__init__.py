@@ -282,8 +282,23 @@ class ClientBuilder:
         # with empty stores
         from ..beacon_chain.recovery import recover_node_state
 
+        # interop nodes have no real engine-API endpoint; once bellatrix is
+        # scheduled, block production needs SOME execution layer or every
+        # post-merge proposal dies on "payload parent hash mismatch" (the
+        # default payload stands in pre-merge only). The reference's interop
+        # mode runs its mock_execution_layer for the same reason — the mock's
+        # genesis block hash is what interop_genesis_state anchors the
+        # payload-header chain on.
+        execution_layer = None
+        from ..types.spec import FAR_FUTURE_EPOCH
+
+        if self.spec.bellatrix_fork_epoch != FAR_FUTURE_EPOCH:
+            from ..execution_layer.mock import MockExecutionLayer
+
+            execution_layer = MockExecutionLayer()
         chain, op_pool, recovered = recover_node_state(
-            self.spec, state, store, slot_clock=clock
+            self.spec, state, store, slot_clock=clock,
+            execution_layer=execution_layer,
         )
         if self._eth1 is not None:
             chain.eth1_service = self._eth1

@@ -362,6 +362,119 @@ def gen_epoch_processing(root: str, config: str = "minimal") -> None:
         _w(d, "post.ssz", state_cls.encode(post))
 
 
+def gen_rewards(root: str, config: str = "minimal") -> None:
+    """Per-fork rewards vectors: pre.ssz is a state on the last slot of its
+    epoch, deltas.json the per-validator balance deltas the rewards stages
+    must produce. Generator and runner share ``handler._apply_rewards``, so
+    the vectors freeze today's columnar-numpy truth — the exact outputs the
+    device epoch kernels (including electra) are parity-tested against.
+    Case 1 is a leak twin: finality rolled back far past
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY with participation gutted, so the
+    inactivity-leak branch pays real penalties."""
+    from ..state_transition import process_slots
+    from ..types.containers import Checkpoint
+
+    from .handler import _apply_rewards
+
+    for fork in FORKS:
+        h = _harness(fork)
+        h.extend_chain(h.spec.preset.SLOTS_PER_EPOCH + 2)
+        spe = h.spec.preset.SLOTS_PER_EPOCH
+        state = h.state.copy()
+        target = (state.slot // spe + 1) * spe - 1
+        process_slots(h.spec, state, target)
+        state_cls = type(state)
+
+        def emit(idx, st):
+            d = _case_dir(root, config, fork, "rewards", "core", idx)
+            _w(d, "pre.ssz", state_cls.encode(st))
+            post = st.copy()
+            _apply_rewards(h.spec, post)
+            _w(
+                d,
+                "deltas.json",
+                {
+                    "deltas": [
+                        int(a) - int(b)
+                        for a, b in zip(post.balances, st.balances)
+                    ]
+                },
+            )
+
+        emit(0, state)
+
+        # leak twin: park the state deep in an unfinalized stretch. The
+        # slot jump skips the block-roots history on purpose — target/head
+        # lookups then miss, which IS the leak's non-participation.
+        leak = state.copy()
+        leak.slot = 8 * spe - 1
+        leak.finalized_checkpoint = Checkpoint(epoch=0, root=b"\x00" * 32)
+        leak.justification_bits = np.zeros(4, dtype=bool)
+        if fork == "phase0":
+            leak.previous_epoch_attestations = list(
+                leak.previous_epoch_attestations
+            )[:1]
+        else:
+            for field in (
+                "previous_epoch_participation",
+                "current_epoch_participation",
+            ):
+                part = np.asarray(getattr(leak, field), dtype=np.uint8)
+                part[::2] = 0  # half the set stops attesting: no 2/3 quorum
+                setattr(leak, field, part)
+            scores = np.asarray(leak.inactivity_scores, dtype=np.uint64)
+            scores[:] = 50  # a standing score makes the penalty term bite
+            leak.inactivity_scores = scores
+        emit(1, leak)
+
+
+def gen_finality(root: str, config: str = "minimal") -> None:
+    """Finality vectors (cases/finality.rs shape): pre.ssz + a multi-epoch
+    block chain -> post.ssz, meta.json pinning the justified/finalized
+    checkpoints the full transition must reach. One fork per epoch-kernel
+    family (phase0 / altair / electra) — bellatrix, capella and deneb share
+    the altair family's epoch stage sequence bit-for-bit, so their four-epoch
+    signed-block chains would re-verify ~100 block signatures each for zero
+    added epoch coverage (their block-level differences are pinned by the
+    operations and epoch_processing families); tier-1 wall clock matters
+    (ISSUE 19: keep added tier-1 tests lean)."""
+    for fork in ("phase0", "altair", "electra"):
+        h = _harness(fork)
+        h.extend_chain(2)
+        pre = h.state.copy()
+        state_cls = type(pre)
+        spe = h.spec.preset.SLOTS_PER_EPOCH
+        blocks = []
+        while h.state.slot < 4 * spe + 1:
+            slot = h.state.slot + 1
+            prev = h.state
+            atts = []
+            if prev.slot + h.spec.min_attestation_inclusion_delay <= slot:
+                atts = h.attestations_for_slot(prev, prev.slot, h.head_root(prev))
+            block = h.produce_block(slot, attestations=atts)
+            h.apply_block(block)
+            blocks.append(block)
+        post = h.state
+        assert int(post.finalized_checkpoint.epoch) >= 2, (
+            f"{fork}: finality never advanced"
+        )
+        d = _case_dir(root, config, fork, "finality", "core", 0)
+        _w(
+            d,
+            "meta.json",
+            {
+                "finalized_epoch": int(post.finalized_checkpoint.epoch),
+                "justified_epoch": int(
+                    post.current_justified_checkpoint.epoch
+                ),
+            },
+        )
+        _w(d, "pre.ssz", state_cls.encode(pre))
+        for i, b in enumerate(blocks):
+            _w(d, f"blocks_{i}.ssz", type(b).encode(b))
+        _w(d, "post.ssz", state_cls.encode(post))
+
+
 def gen_sanity_blocks(root: str, config: str = "minimal") -> None:
     for fork in FORKS:
         h = _harness(fork)
@@ -839,6 +952,8 @@ def main(root: str | None = None) -> None:
     gen_ssz_static(root)
     gen_operations(root)
     gen_operations_merge(root)
+    gen_rewards(root)
+    gen_finality(root)
     gen_epoch_processing(root)
     gen_sanity_blocks(root)
     gen_transition(root)

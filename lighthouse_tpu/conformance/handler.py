@@ -336,6 +336,85 @@ def case_operations(ctx: CaseContext, config: str, fork: str, handler: str):
         raise ConformanceError(f"{ctx.path}: post-state root mismatch")
 
 
+def _apply_rewards(spec, state):
+    """The rewards sub-transition slice shared by the generator and the
+    rewards runner: justification/finalization first (it feeds the
+    finality-delay / leak terms), then (altair+) inactivity updates, then
+    rewards/penalties — the head of ``_process_epoch_phase0`` /
+    ``_process_epoch_altair``, in production order."""
+    from ..state_transition import per_epoch as pe
+
+    cols = pe._Cols(state)
+    if getattr(state, "fork_name", "phase0") == "phase0":
+        pe.process_justification_and_finalization_phase0(spec, state, cols)
+        pe.process_rewards_and_penalties_phase0(spec, state, cols)
+    else:
+        pe.process_justification_and_finalization_altair(spec, state, cols)
+        pe.process_inactivity_updates(spec, state, cols)
+        pe.process_rewards_and_penalties_altair(spec, state, cols)
+
+
+def case_rewards(ctx: CaseContext, config: str, fork: str, handler: str):
+    """pre.ssz + deltas.json: the per-validator balance deltas the rewards
+    stages must produce (cases/rewards.rs shape, fused across components).
+    These pin the exact columnar-numpy outputs the device epoch kernels are
+    parity-tested against — including the electra fork family."""
+    ns, spec = _ns_and_spec(config, fork)
+    state_cls = _ssz_type(ns, fork, "BeaconState")
+    state = state_cls.decode(ctx.read("pre.ssz"))
+    pre_bal = [int(b) for b in state.balances]
+    _apply_rewards(spec, state)
+    expected = ctx.json("deltas.json")["deltas"]
+    got = [int(a) - b for a, b in zip(state.balances, pre_bal)]
+    if got != expected:
+        diffs = [i for i, (g, e) in enumerate(zip(got, expected)) if g != e]
+        raise ConformanceError(
+            f"{ctx.path}: reward deltas mismatch at validators {diffs[:8]}"
+        )
+
+
+def case_finality(ctx: CaseContext, config: str, fork: str, handler: str):
+    """pre.ssz + a multi-epoch block chain -> post.ssz, with meta.json
+    pinning the justified/finalized checkpoints the full transition must
+    reach (cases/finality.rs). The chain crosses epoch boundaries, so every
+    epoch stage — device-kernel or columnar — is on the hook."""
+    from ..state_transition import (
+        BlockSignatureStrategy,
+        per_block_processing,
+        process_slots,
+    )
+
+    ns, spec = _ns_and_spec(config, fork)
+    state_cls = _ssz_type(ns, fork, "BeaconState")
+    block_cls = _ssz_type(ns, fork, "SignedBeaconBlock")
+    meta = ctx.json("meta.json")
+    state = state_cls.decode(ctx.read("pre.ssz"))
+    i = 0
+    while ctx.has(f"blocks_{i}.ssz"):
+        sb = block_cls.decode(ctx.read(f"blocks_{i}.ssz"))
+        if state.slot < sb.message.slot:
+            process_slots(spec, state, sb.message.slot)
+        per_block_processing(
+            spec, state, sb, strategy=BlockSignatureStrategy.VERIFY_BULK
+        )
+        i += 1
+    if int(state.finalized_checkpoint.epoch) != meta["finalized_epoch"]:
+        raise ConformanceError(
+            f"{ctx.path}: finalized epoch "
+            f"{int(state.finalized_checkpoint.epoch)} != "
+            f"{meta['finalized_epoch']}"
+        )
+    if int(state.current_justified_checkpoint.epoch) != meta["justified_epoch"]:
+        raise ConformanceError(
+            f"{ctx.path}: justified epoch "
+            f"{int(state.current_justified_checkpoint.epoch)} != "
+            f"{meta['justified_epoch']}"
+        )
+    post = state_cls.decode(ctx.read("post.ssz"))
+    if state.tree_root() != post.tree_root():
+        raise ConformanceError(f"{ctx.path}: finality post-state mismatch")
+
+
 def case_epoch_processing(ctx: CaseContext, config: str, fork: str, handler: str):
     """pre.ssz -> process_epoch -> post.ssz (cases/epoch_processing.rs, fused
     single-pass instead of per-sub-transition)."""
@@ -509,6 +588,8 @@ _RUNNERS = {
     "shuffling": case_shuffling,
     "bls": case_bls,
     "operations": case_operations,
+    "rewards": case_rewards,
+    "finality": case_finality,
     "epoch_processing": case_epoch_processing,
     "sanity_blocks": case_sanity_blocks,
     "transition": case_transition,

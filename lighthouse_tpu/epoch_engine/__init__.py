@@ -9,8 +9,10 @@ Backends:
 
 * ``numpy``  — the columnar host path in ``state_transition/per_epoch.py``.
 * ``device`` — the fused jitted sweep (``engine.py`` + ``kernels.py``) over
-  a device-resident registry mirror (``mirror.py``); falls back to numpy
-  per-state only for forks the kernel does not cover (electra+).
+  a device-resident registry mirror (``mirror.py``). Covers every fork
+  through electra (three kernel families: phase0 / altair-like / electra
+  with its pending-deposit + consolidation queue stages); a fork newer
+  than the kernel families falls back to numpy per-state.
 * ``auto``   — the default: ``device`` when an accelerator platform (tpu/
   gpu) backs JAX, ``numpy`` otherwise, so CPU-only test tiers never pay
   kernel compiles they didn't ask for.

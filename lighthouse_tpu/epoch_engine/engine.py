@@ -10,20 +10,27 @@ resets, historical accumulators, participation rotation, sync-committee
 rotation) in exactly the numpy path's order. Everything per-validator is the
 one jitted kernel; everything here is O(changed rows + attestations).
 
-Fork coverage: phase0 and the altair family (altair/bellatrix/capella/deneb
-— they share the participation-flag epoch transition and differ only in
-constants baked into ``EpochConsts``). Electra's pending-deposit /
-consolidation sweeps are not kernelized; those states fall back to numpy.
+Fork coverage: phase0, the altair family (altair/bellatrix/capella/deneb —
+they share the participation-flag epoch transition and differ only in
+constants baked into ``EpochConsts``), and electra. The electra sweep adds
+the EIP-7251 stages on-device (balance-churned registry updates, the
+pending-deposit cumulative sum with its scatter-add, the consolidation
+scan, per-validator effective-balance caps); the only residual host work is
+the part that cannot live on the validator axis — appending brand-new
+validators for unknown-pubkey deposits (BLS proof-of-possession included)
+and rebuilding the pending queues from the kernel's stop positions.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from .kernels import consts_for, run_sweep
+from .kernels import consts_for, queue_bucket, run_sweep
 from .mirror import RegistryMirror
 
-_SUPPORTED_FORKS = ("phase0", "altair", "bellatrix", "capella", "deneb")
+_SUPPORTED_FORKS = (
+    "phase0", "altair", "bellatrix", "capella", "deneb", "electra",
+)
 
 _MIRROR_ATTR = "_epoch_mirror"
 
@@ -105,6 +112,21 @@ def _device_sweep(spec, state, sharding):
             int(np.asarray(state.slashings, dtype=np.uint64).sum())
         ),
     }
+    if consts.family == "electra":
+        _electra_queue_columns(state, mirror, consts, cols)
+        scalars["earliest_exit_epoch"] = np.uint64(
+            state.earliest_exit_epoch
+        )
+        scalars["exit_balance_to_consume"] = np.uint64(
+            state.exit_balance_to_consume
+        )
+        scalars["deposit_balance_to_consume"] = np.uint64(
+            state.deposit_balance_to_consume
+        )
+        scalars["eth1_deposit_index"] = np.uint64(state.eth1_deposit_index)
+        scalars["deposit_requests_start_index"] = np.uint64(
+            state.deposit_requests_start_index
+        )
 
     outs = run_sweep(consts, cols, scalars)
     # force completion (keeping outputs device-resident for the mirror):
@@ -154,6 +176,10 @@ def process_epoch_on_device(spec, state, sharding=None) -> bool:
     mirror.stats.device_to_host_bytes += n * 8
     mirror.apply_outputs(state, outs)
 
+    from ..types.spec import fork_at_least
+
+    if fork_at_least(fork, "electra"):
+        _electra_host_finish(spec, state, mirror, outs)
     _host_tail(spec, state, fork)
     return True
 
@@ -236,6 +262,106 @@ def _phase0_host_columns(spec, state, mirror, cols) -> None:
     cols["incl_delay"] = mirror.pad_and_put(incl_delay, fill=1)
     cols["incl_proposer"] = mirror.pad_and_put(incl_proposer, fill=0)
     cols["has_incl"] = mirror.pad_and_put(has_incl, fill=False)
+
+
+class _MirrorPubkeyCtxt:
+    """``lookup_pubkey_index`` context backed by the mirror's lazy pubkey
+    map — the map auto-extends over registry appends, so a second pending
+    deposit for a pubkey the previous one just added resolves to the new
+    index exactly like the numpy twin's linear scan."""
+
+    def __init__(self, mirror):
+        self._mirror = mirror
+
+    def lookup_pubkey_index(self, state, pubkey):
+        return self._mirror.pubkey_map(state).get(bytes(pubkey))
+
+
+def _electra_queue_columns(state, mirror, consts, cols) -> None:
+    """Upload the electra pending-queue columns. Only the first
+    MAX_PENDING_DEPOSITS_PER_EPOCH deposits can ever be examined by the
+    sweep (every loop iteration advances the capped position counter), so
+    the deposit columns are a FIXED shape — zero steady-state recompiles
+    regardless of queue depth. Pubkeys resolve host-side against the
+    mirror's map; unknown pubkeys (-1) are flagged for host application."""
+    maxq = consts.max_pending_deposits_per_epoch
+    pending = list(state.pending_deposits)[:maxq]
+    dep_amount = np.zeros(maxq, dtype=np.uint64)
+    dep_slot = np.zeros(maxq, dtype=np.uint64)
+    dep_index = np.full(maxq, -1, dtype=np.int32)
+    dep_valid = np.zeros(maxq, dtype=bool)
+    if pending:
+        pkmap = mirror.pubkey_map(state)
+        for i, d in enumerate(pending):
+            dep_amount[i] = int(d.amount)
+            dep_slot[i] = int(d.slot)
+            dep_index[i] = pkmap.get(bytes(d.pubkey), -1)
+            dep_valid[i] = True
+    cols["dep_amount"] = mirror.put_aux(dep_amount)
+    cols["dep_slot"] = mirror.put_aux(dep_slot)
+    cols["dep_index"] = mirror.put_aux(dep_index)
+    cols["dep_valid"] = mirror.put_aux(dep_valid)
+
+    cons = list(state.pending_consolidations)
+    qc = queue_bucket(len(cons))
+    con_src = np.zeros(qc, dtype=np.int32)
+    con_tgt = np.zeros(qc, dtype=np.int32)
+    con_valid = np.zeros(qc, dtype=bool)
+    for i, c in enumerate(cons):
+        con_src[i] = int(c.source_index)
+        con_tgt[i] = int(c.target_index)
+        con_valid[i] = True
+    cols["con_src"] = mirror.put_aux(con_src)
+    cols["con_tgt"] = mirror.put_aux(con_tgt)
+    cols["con_valid"] = mirror.put_aux(con_valid)
+
+
+def _electra_host_finish(spec, state, mirror, outs) -> None:
+    """The residual host half of the electra stages, after the mirror
+    write-back: apply unknown-pubkey deposits in queue order (registry
+    appends + proof-of-possession checks), run the hysteresis update for
+    rows appended after the kernel's effective-balance stage ran, rebuild
+    the pending queues from the kernel's stop positions, and land the
+    scalar churn carries."""
+    from ..state_transition.electra import (
+        apply_pending_deposit,
+        get_max_effective_balance,
+    )
+
+    s = int(outs["dep_stop"])
+    postponed = np.asarray(outs["dep_postponed"])
+    host_mask = np.asarray(outs["dep_host"])
+    pending = list(state.pending_deposits)
+    n_pre = len(state.validators)
+    ctxt = _MirrorPubkeyCtxt(mirror)
+    for i in range(s):
+        if host_mask[i]:
+            apply_pending_deposit(spec, state, pending[i], ctxt)
+    # hysteresis for appended validators (effective starts at 0; the numpy
+    # twin's effective-balance loop runs after deposits and fixes them up)
+    inc = spec.effective_balance_increment
+    down = inc // 4
+    up = inc // 4 * 5
+    bal = np.asarray(state.balances, dtype=np.uint64)
+    for i in range(n_pre, len(state.validators)):
+        v = state.validators[i]
+        b = int(bal[i])
+        if b + down < int(v.effective_balance) or (
+            int(v.effective_balance) + up < b
+        ):
+            v.effective_balance = min(
+                b - b % inc, get_max_effective_balance(spec, v)
+            )
+    state.pending_deposits = pending[s:] + [
+        pending[i] for i in range(s) if postponed[i]
+    ]
+    state.deposit_balance_to_consume = int(outs["dep_btc"])
+    state.pending_consolidations = list(state.pending_consolidations)[
+        int(outs["cons_consumed"]):
+    ]
+    if bool(outs["has_ejection"]):
+        state.earliest_exit_epoch = int(outs["earliest_exit"])
+        state.exit_balance_to_consume = int(outs["exit_btc"])
 
 
 def _apply_justification(spec, state, outs) -> None:

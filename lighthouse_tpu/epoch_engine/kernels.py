@@ -1,11 +1,17 @@
 """Fused single-pass epoch kernels over the validator axis.
 
-One ``jit``-compiled sweep per fork family (phase0 / altair-like) computes
-everything ``per_epoch.py`` does per validator — justification balances,
-inactivity scores, rewards/penalties, registry updates (eligibility,
-ejections with exact exit-queue semantics, the churn-limited activation
-queue), slashing penalties, and hysteresis effective-balance updates — as
-one XLA program. The validator axis is padded to a shape bucket so the
+One ``jit``-compiled sweep per fork family (phase0 / altair-like / electra)
+computes everything ``per_epoch.py`` does per validator — justification
+balances, inactivity scores, rewards/penalties, registry updates
+(eligibility, ejections with exact exit-queue semantics, the churn-limited
+activation queue), slashing penalties, and hysteresis effective-balance
+updates — as one XLA program. The electra family adds the EIP-7251 stages
+in the same idiom: balance-denominated exit churn as a prefix sum, the
+pending-deposit queue as a masked cumulative sum against the
+activation-exit budget with one scatter-add into balances, the
+pending-consolidation queue as a short ``lax.scan``, and a per-validator
+``max_effective_balance`` plane (compounding 2048 ETH vs 32 ETH
+credentials). The validator axis is padded to a shape bucket so the
 registry can grow without recompiling, and padding rows are arithmetic
 no-ops (inactive, zero-balance, far-future epochs).
 
@@ -51,7 +57,7 @@ TIMELY_HEAD_FLAG_INDEX = 2
 class EpochConsts(NamedTuple):
     """Hashable spec snapshot baked into the jitted sweep (static arg)."""
 
-    family: str  # "phase0" | "altair"
+    family: str  # "phase0" | "altair" | "electra"
     effective_balance_increment: int
     max_effective_balance: int
     ejection_balance: int
@@ -73,12 +79,24 @@ class EpochConsts(NamedTuple):
     # deneb+ caps the activation churn
     cap_activation_churn: bool
     max_per_epoch_activation_churn_limit: int
+    # electra family (EIP-7251 balance-denominated churn + pending queues)
+    min_activation_balance: int = 0
+    max_effective_balance_electra: int = 0
+    min_per_epoch_churn_limit_electra: int = 0
+    max_per_epoch_activation_exit_churn_limit: int = 0
+    max_pending_deposits_per_epoch: int = 0
+    slots_per_epoch: int = 0
 
 
 def consts_for(spec, fork: str) -> EpochConsts:
     from ..types.spec import fork_at_least, proportional_slashing_multiplier_for
 
-    family = "phase0" if fork == "phase0" else "altair"
+    if fork == "phase0":
+        family = "phase0"
+    elif fork_at_least(fork, "electra"):
+        family = "electra"
+    else:
+        family = "altair"
     mult = proportional_slashing_multiplier_for(spec, fork)
     return EpochConsts(
         family=family,
@@ -106,6 +124,18 @@ def consts_for(spec, fork: str) -> EpochConsts:
         max_per_epoch_activation_churn_limit=(
             spec.max_per_epoch_activation_churn_limit
         ),
+        min_activation_balance=spec.min_activation_balance,
+        max_effective_balance_electra=spec.max_effective_balance_electra,
+        min_per_epoch_churn_limit_electra=(
+            spec.min_per_epoch_churn_limit_electra
+        ),
+        max_per_epoch_activation_exit_churn_limit=(
+            spec.max_per_epoch_activation_exit_churn_limit
+        ),
+        max_pending_deposits_per_epoch=(
+            spec.preset.MAX_PENDING_DEPOSITS_PER_EPOCH
+        ),
+        slots_per_epoch=spec.preset.SLOTS_PER_EPOCH,
     )
 
 
@@ -113,6 +143,15 @@ def bucket(n: int) -> int:
     """Validator-axis shape bucket: power of two >= 256 (multiple of any
     mesh size, and the registry grows without recompiles)."""
     b = 256
+    while b < n:
+        b *= 2
+    return b
+
+
+def queue_bucket(n: int) -> int:
+    """Pending-consolidation-queue shape bucket: power of two >= 8, so the
+    queue length only triggers a recompile on (rare) growth past a bucket."""
+    b = 8
     while b < n:
         b *= 2
     return b
@@ -242,12 +281,17 @@ def _slashings(C: EpochConsts, cur_ep, total, slash_sum, effective, slashed,
     )
     target_wd = cur_ep + _u64(C.epochs_per_slashings_vector // 2)
     hit = slashed & (withdrawable_snapshot == target_wd)
-    penalty = effective // inc * adjusted // total * inc
+    if C.family == "electra":
+        # EIP-7251 overflow-safe form: per-increment penalty first
+        per_increment = adjusted // (total // inc)
+        penalty = effective // inc * per_increment
+    else:
+        penalty = effective // inc * adjusted // total * inc
     dec = jnp.minimum(penalty, balances)
     return jnp.where(hit, balances - dec, balances)
 
 
-def _effective_updates(C: EpochConsts, balances, effective):
+def _effective_updates(C: EpochConsts, balances, effective, max_eff=None):
     import jax.numpy as jnp
 
     inc = _u64(C.effective_balance_increment)
@@ -255,13 +299,16 @@ def _effective_updates(C: EpochConsts, balances, effective):
     down = hysteresis  # HYSTERESIS_DOWNWARD_MULTIPLIER = 1
     up = hysteresis * _u64(5)  # HYSTERESIS_UPWARD_MULTIPLIER = 5
     need = (balances + down < effective) | (effective + up < balances)
-    capped = jnp.minimum(
-        balances - balances % inc, _u64(C.max_effective_balance)
-    )
+    if max_eff is None:
+        max_eff = _u64(C.max_effective_balance)
+    capped = jnp.minimum(balances - balances % inc, max_eff)
     return jnp.where(need, capped, effective)
 
 
-def _sweep_altair(C: EpochConsts, cols, scalars):
+def _altair_head(C: EpochConsts, cols, scalars):
+    """The fork-independent front of the altair-family sweep: justification,
+    inactivity updates, and rewards/penalties. Returns the intermediate
+    planes both the altair and electra tails build on."""
     import jax.numpy as jnp
 
     effective = cols["effective"]
@@ -269,7 +316,6 @@ def _sweep_altair(C: EpochConsts, cols, scalars):
     activation = cols["activation"]
     exit_ep = cols["exit"]
     withdrawable = cols["withdrawable"]
-    eligibility = cols["eligibility"]
     balances = cols["balances"]
     inact = cols["inactivity"]
     prev_part = cols["prev_part"]
@@ -360,6 +406,34 @@ def _sweep_altair(C: EpochConsts, cols, scalars):
     pen = jnp.where(do_rp, penalties, zero)
     bal = bal - jnp.minimum(pen, bal)
 
+    return {
+        "bal": bal,
+        "inact_new": inact_new,
+        "bits": new_bits,
+        "cj_prev": cj_prev,
+        "cj_cur": cj_cur,
+        "fin_sel": fin_sel,
+        "f_new": f_new,
+        "do_just": do_just,
+        "active_cur": active_cur,
+        "total": total,
+    }
+
+
+def _sweep_altair(C: EpochConsts, cols, scalars):
+    effective = cols["effective"]
+    slashed = cols["slashed"]
+    activation = cols["activation"]
+    exit_ep = cols["exit"]
+    withdrawable = cols["withdrawable"]
+    eligibility = cols["eligibility"]
+    cur_ep = scalars["cur_epoch"]
+    slash_sum = scalars["slash_sum"]
+
+    h = _altair_head(C, cols, scalars)
+    bal, f_new = h["bal"], h["f_new"]
+    total, active_cur = h["total"], h["active_cur"]
+
     # --- registry updates / slashings / effective balances ---------------
     elig_new, exit_new, wd_new, act_new = _registry_updates(
         C, cur_ep, f_new, effective, activation, exit_ep,
@@ -372,18 +446,18 @@ def _sweep_altair(C: EpochConsts, cols, scalars):
 
     return {
         "balances": bal,
-        "inactivity": inact_new,
+        "inactivity": h["inact_new"],
         "effective": eff_new,
         "activation": act_new,
         "exit": exit_new,
         "withdrawable": wd_new,
         "eligibility": elig_new,
-        "bits": new_bits,
-        "cj_prev": cj_prev,
-        "cj_cur": cj_cur,
-        "fin_sel": fin_sel,
+        "bits": h["bits"],
+        "cj_prev": h["cj_prev"],
+        "cj_cur": h["cj_cur"],
+        "fin_sel": h["fin_sel"],
         "f_new": f_new,
-        "do_just": do_just,
+        "do_just": h["do_just"],
     }
 
 
@@ -521,13 +595,282 @@ def _sweep_phase0(C: EpochConsts, cols, scalars):
     }
 
 
+# =============================================================================
+# electra family (EIP-7251 balance churn + pending deposit/consolidation queues)
+# =============================================================================
+
+
+def _balance_churn_limits(C: EpochConsts, total):
+    """get_balance_churn_limit / get_activation_exit_churn_limit — the
+    balance-denominated churn (EIP-7251), floored to the increment."""
+    import jax.numpy as jnp
+
+    inc = _u64(C.effective_balance_increment)
+    churn = jnp.maximum(
+        _u64(C.min_per_epoch_churn_limit_electra),
+        total // _u64(C.churn_limit_quotient),
+    )
+    churn = churn - churn % inc
+    aexit = jnp.minimum(
+        _u64(C.max_per_epoch_activation_exit_churn_limit), churn
+    )
+    return churn, aexit
+
+
+def _registry_updates_electra(C: EpochConsts, cur_ep, f_new, effective,
+                              activation, exit_ep, withdrawable, eligibility,
+                              active_cur, earliest_exit_in, exit_btc_in,
+                              churn_aexit):
+    """Electra process_registry_updates: MIN_ACTIVATION_BALANCE eligibility,
+    balance-churned ejections in closed form, and limit-free activations.
+
+    ``compute_exit_epoch_and_update_churn``'s sequential per-ejection loop
+    collapses to a prefix sum: with ``E0 = max(earliest_exit, cur+1+lookahead)``
+    and ``btc0`` the epoch's starting exit budget, the k-th ejection (index
+    order, inclusive balance cumsum ``C_k``) lands on epoch
+    ``E0 + ceil_div(max(C_k - btc0, 0), churn)`` — because each call only ever
+    advances the shared ``earliest_exit_epoch`` / ``exit_balance_to_consume``
+    pair by exactly the epochs its balance overflows the running budget."""
+    import jax.numpy as jnp
+
+    far = _u64(FAR_FUTURE_EPOCH)
+    one = _u64(1)
+    elig_new = jnp.where(
+        (eligibility == far)
+        & (effective >= _u64(C.min_activation_balance)),
+        cur_ep + one,
+        eligibility,
+    )
+    eject = (
+        active_cur
+        & (effective <= _u64(C.ejection_balance))
+        & (exit_ep == far)
+    )
+    min_exit = cur_ep + one + _u64(C.max_seed_lookahead)
+    e0 = jnp.maximum(earliest_exit_in, min_exit)
+    btc0 = jnp.where(earliest_exit_in < e0, churn_aexit, exit_btc_in)
+    csum = jnp.cumsum(jnp.where(eject, effective, _u64(0)))
+    add = jnp.where(
+        csum > btc0, (csum - btc0 - one) // churn_aexit + one, _u64(0)
+    )
+    assigned = e0 + add
+    exit_new = jnp.where(eject, assigned, exit_ep)
+    wd_new = jnp.where(
+        eject,
+        assigned + _u64(C.min_validator_withdrawability_delay),
+        withdrawable,
+    )
+    has_ejection = jnp.any(eject)
+    earliest_out = e0 + add[-1]
+    btc_out = btc0 + add[-1] * churn_aexit - csum[-1]
+    # activations: every finalized-eligible candidate activates (EIP-7251
+    # throttles via the pending-deposit balance churn, not a queue limit)
+    cand = (elig_new <= f_new) & (activation == far)
+    act_new = jnp.where(cand, min_exit, activation)
+    return (
+        elig_new, exit_new, wd_new, act_new,
+        has_ejection, earliest_out, btc_out,
+    )
+
+
+def _deposits_stage(C: EpochConsts, next_ep, f_new, exit_new, wd_new,
+                    balances, dep_amount, dep_slot, dep_index, dep_valid,
+                    dbtc_in, churn_aexit, eth1_deposit_index,
+                    deposit_requests_start_index):
+    """process_pending_deposits as a masked cumulative sum over the first
+    MAX_PENDING_DEPOSITS_PER_EPOCH queue entries (the loop can never examine
+    more: every iteration advances the capped position counter).
+
+    The sequential loop's three break conditions become three stop
+    positions — first gate failure (EIP-6110 bridge wait / finality wait),
+    first churn overflow among budget-consuming entries, and queue/cap
+    exhaustion — and the realized stop is their minimum. The churn break is
+    only reachable strictly before the others (gates are tested first in
+    the loop body), which is exactly when the numpy twin leaves
+    ``is_churn_limit_reached`` True. Known-index applications scatter-add
+    into balances here; unknown-pubkey entries (registry appends + their
+    BLS proof-of-possession check) are flagged for the host."""
+    import jax.numpy as jnp
+
+    maxq = dep_amount.shape[0]
+    zero = _u64(0)
+    pos = jnp.arange(maxq, dtype=jnp.int32)
+    big = jnp.int32(maxq)
+    finalized_slot = f_new * _u64(C.slots_per_epoch)
+    bridge_wait = (dep_slot > zero) & (
+        eth1_deposit_index < deposit_requests_start_index
+    )
+    gate_fail = dep_valid & (bridge_wait | (dep_slot > finalized_slot))
+    s_gate = jnp.min(jnp.where(gate_fail, pos, big))
+    n_valid = jnp.sum(dep_valid.astype(jnp.int32))
+    known = dep_index >= 0
+    gi = jnp.clip(dep_index, 0, exit_new.shape[0] - 1)
+    withdrawn = dep_valid & known & (wd_new[gi] < next_ep)
+    exited = (
+        dep_valid & known & ~withdrawn
+        & (exit_new[gi] < _u64(FAR_FUTURE_EPOCH))
+    )
+    consumes = dep_valid & ~withdrawn & ~exited  # the budget-charged branch
+    csum = jnp.cumsum(jnp.where(consumes, dep_amount, zero))
+    available = dbtc_in + churn_aexit
+    churn_hit = consumes & (csum > available)
+    s_churn = jnp.min(jnp.where(churn_hit, pos, big))
+    s_other = jnp.minimum(s_gate, n_valid)
+    s = jnp.minimum(s_churn, s_other)
+    churn_reached = s_churn < s_other
+    processed = jnp.sum(jnp.where(consumes & (pos < s), dep_amount, zero))
+    apply_dev = (pos < s) & known & (withdrawn | consumes)
+    bal = balances.at[gi].add(jnp.where(apply_dev, dep_amount, zero))
+    postponed = (pos < s) & exited
+    host_apply = (pos < s) & dep_valid & ~known
+    dbtc_out = jnp.where(churn_reached, available - processed, zero)
+    return bal, s, postponed, host_apply, dbtc_out
+
+
+def _consolidations_scan(C: EpochConsts, next_ep, slashed, effective,
+                         wd_new, balances, con_src, con_tgt, con_valid):
+    """process_pending_consolidations as a short ``lax.scan`` over the
+    padded queue bucket: the sweep is order-dependent (duplicate sources /
+    consolidation chains move running balances), so each step moves
+    ``min(balance, effective)`` source→target against the carried balance
+    plane. Slashed sources are skipped-but-consumed; the first live source
+    still inside its withdrawability delay stops the sweep."""
+    import jax
+    import jax.numpy as jnp
+
+    zero = _u64(0)
+
+    def step(carry, inp):
+        bal, stopped, consumed = carry
+        src, tgt, valid = inp
+        skip = slashed[src]
+        stop_here = valid & ~skip & (wd_new[src] > next_ep)
+        stopped = stopped | stop_here
+        do = valid & ~stopped & ~skip
+        amt = jnp.where(do, jnp.minimum(bal[src], effective[src]), zero)
+        bal = bal.at[src].add(zero - amt)
+        bal = bal.at[tgt].add(amt)
+        consumed = consumed + (valid & ~stopped).astype(jnp.int32)
+        return (bal, stopped, consumed), None
+
+    (bal, _, consumed), _ = jax.lax.scan(
+        step,
+        (balances, jnp.bool_(False), jnp.int32(0)),
+        (con_src, con_tgt, con_valid),
+    )
+    return bal, consumed
+
+
+def _sweep_electra(C: EpochConsts, cols, scalars):
+    import jax.numpy as jnp
+
+    from ..ops.bls.fq import _cert
+
+    effective = cols["effective"]
+    slashed = cols["slashed"]
+    activation = cols["activation"]
+    exit_ep = cols["exit"]
+    withdrawable = cols["withdrawable"]
+    eligibility = cols["eligibility"]
+    compounding = cols["compounding"]
+    cur_ep = scalars["cur_epoch"]
+    slash_sum = scalars["slash_sum"]
+
+    # trace-time proof obligations (recorded by the bounds certifier when
+    # its sink is installed; plain asserts otherwise). Shapes and consts
+    # are static at trace, so these pin the u64/int32 headroom of the
+    # electra-only arithmetic for every compiled specialization.
+    n_pad = effective.shape[0]
+    assert _cert(
+        "epoch_validator_index_domain", n_pad, 2**31 - 1,
+        "validator-axis gather/scatter indices fit int32",
+    )
+    assert _cert(
+        "epoch_churn_cumsum_headroom",
+        n_pad * C.max_effective_balance_electra
+        * max(C.proportional_slashing_multiplier, 1),
+        2**64 - 1,
+        "balance prefix sums and the scaled slashing sum cannot wrap u64",
+    )
+    assert _cert(
+        "epoch_deposit_plane_width",
+        C.max_pending_deposits_per_epoch,
+        cols["dep_amount"].shape[0],
+        "deposit sweep never reads past the fixed queue plane",
+    )
+
+    h = _altair_head(C, cols, scalars)
+    bal, f_new = h["bal"], h["f_new"]
+    total, active_cur = h["total"], h["active_cur"]
+    next_ep = cur_ep + _u64(1)
+
+    _, churn_aexit = _balance_churn_limits(C, total)
+    (
+        elig_new, exit_new, wd_new, act_new,
+        has_ejection, earliest_out, exit_btc_out,
+    ) = _registry_updates_electra(
+        C, cur_ep, f_new, effective, activation, exit_ep, withdrawable,
+        eligibility, active_cur, scalars["earliest_exit_epoch"],
+        scalars["exit_balance_to_consume"], churn_aexit,
+    )
+    bal = _slashings(
+        C, cur_ep, total, slash_sum, effective, slashed, withdrawable, bal
+    )
+    # deposit/consolidation classification reads the POST-registry exit and
+    # withdrawable planes — the numpy twin's loops run after the updates
+    bal, dep_stop, dep_postponed, dep_host, dbtc_out = _deposits_stage(
+        C, next_ep, f_new, exit_new, wd_new, bal,
+        cols["dep_amount"], cols["dep_slot"], cols["dep_index"],
+        cols["dep_valid"], scalars["deposit_balance_to_consume"],
+        churn_aexit, scalars["eth1_deposit_index"],
+        scalars["deposit_requests_start_index"],
+    )
+    bal, cons_consumed = _consolidations_scan(
+        C, next_ep, slashed, effective, wd_new, bal,
+        cols["con_src"], cols["con_tgt"], cols["con_valid"],
+    )
+    max_eff = jnp.where(
+        compounding,
+        _u64(C.max_effective_balance_electra),
+        _u64(C.min_activation_balance),
+    )
+    eff_new = _effective_updates(C, bal, effective, max_eff=max_eff)
+
+    return {
+        "balances": bal,
+        "inactivity": h["inact_new"],
+        "effective": eff_new,
+        "activation": act_new,
+        "exit": exit_new,
+        "withdrawable": wd_new,
+        "eligibility": elig_new,
+        "bits": h["bits"],
+        "cj_prev": h["cj_prev"],
+        "cj_cur": h["cj_cur"],
+        "fin_sel": h["fin_sel"],
+        "f_new": f_new,
+        "do_just": h["do_just"],
+        "dep_stop": dep_stop,
+        "dep_postponed": dep_postponed,
+        "dep_host": dep_host,
+        "dep_btc": dbtc_out,
+        "cons_consumed": cons_consumed,
+        "has_ejection": has_ejection,
+        "earliest_exit": earliest_out,
+        "exit_btc": exit_btc_out,
+    }
+
+
 @functools.lru_cache(maxsize=16)
 def _compiled(consts: EpochConsts):
     """One jitted sweep per (fork family x spec constants); XLA's own cache
     handles the per-shape-bucket specializations underneath."""
     import jax
 
-    body = _sweep_phase0 if consts.family == "phase0" else _sweep_altair
+    body = {
+        "phase0": _sweep_phase0,
+        "electra": _sweep_electra,
+    }.get(consts.family, _sweep_altair)
     return jax.jit(functools.partial(body, consts))
 
 

@@ -3,8 +3,9 @@
 The numpy epoch path re-gathers every validator's fields out of Python
 objects into ``_Cols`` arrays each epoch — an O(n) interpreted loop that
 dwarfs the arithmetic at mainnet scale. The mirror gathers ONCE per state
-lineage, keeps the six epoch-processing registry columns as device arrays
-(struct-of-arrays), and between epochs applies only the rows the block-level
+lineage, keeps the epoch-processing registry columns as device arrays
+(struct-of-arrays, including the derived electra ``compounding``-credential
+plane), and between epochs applies only the rows the block-level
 delta journal (``deltas.py``) marked dirty: a handful of slashings/exits/
 deposits per epoch instead of a million-object sweep.
 
@@ -33,6 +34,7 @@ _REG_DTYPES = {
     "exit": np.uint64,
     "withdrawable": np.uint64,
     "eligibility": np.uint64,
+    "compounding": np.bool_,
 }
 
 _FIELD_ATTRS = {
@@ -44,6 +46,15 @@ _FIELD_ATTRS = {
     "eligibility": "activation_eligibility_epoch",
 }
 
+# columns derived from validator fields rather than read off an attribute.
+# "compounding" feeds the electra per-validator max_effective_balance plane;
+# mutation sites that rewrite withdrawal_credentials journal the row
+# (switch_to_compounding_validator), and pre-electra credential changes
+# (capella 0x00 -> 0x01) never flip the 0x02 test, so delta syncs stay exact.
+_DERIVED = {
+    "compounding": lambda v: bytes(v.withdrawal_credentials)[:1] == b"\x02",
+}
+
 # padding row: an inactive, zero-balance validator that every kernel stage
 # provably ignores
 _PAD_VALUES = {
@@ -53,7 +64,15 @@ _PAD_VALUES = {
     "exit": FAR_FUTURE_EPOCH,
     "withdrawable": FAR_FUTURE_EPOCH,
     "eligibility": FAR_FUTURE_EPOCH,
+    "compounding": False,
 }
+
+
+def _field_value(v, name):
+    getter = _DERIVED.get(name)
+    if getter is not None:
+        return getter(v)
+    return getattr(v, _FIELD_ATTRS[name])
 
 
 @dataclass
@@ -81,6 +100,8 @@ class RegistryMirror:
         self.shadow: dict[str, np.ndarray] = {}  # name -> numpy (padded)
         self.sharding = sharding
         self.stats = MirrorStats()
+        self._pubkey_map: dict[bytes, int] | None = None
+        self._pubkey_n = 0
 
     # -- host<->device helpers -------------------------------------------
 
@@ -101,6 +122,37 @@ class RegistryMirror:
             padded[: arr.shape[0]] = arr
             arr = padded
         return self._put(arr)
+
+    def put_aux(self, arr: np.ndarray):
+        """Upload a small non-validator-axis array (the electra pending-queue
+        columns): replicated across the mesh when the mirror shards the
+        validator axis, so queue gathers do not force a resharding."""
+        import jax
+
+        self.stats.host_to_device_bytes += arr.nbytes
+        self.stats.last_host_to_device_bytes += arr.nbytes
+        if self.sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                arr, NamedSharding(self.sharding.mesh, PartitionSpec())
+            )
+        return jax.device_put(arr)
+
+    def pubkey_map(self, state) -> dict[bytes, int]:
+        """Lazy pubkey -> validator-index map over this mirror's state
+        lineage (the registry is append-only and pubkeys are immutable, so
+        the map only ever extends)."""
+        vs = state.validators
+        m = self._pubkey_map
+        if m is None:
+            m = {}
+            self._pubkey_map = m
+            self._pubkey_n = 0
+        for i in range(self._pubkey_n, len(vs)):
+            m[bytes(vs[i].pubkey)] = i
+        self._pubkey_n = len(vs)
+        return m
 
     # -- sync -------------------------------------------------------------
 
@@ -130,9 +182,8 @@ class RegistryMirror:
         self.n = n
         self.n_pad = bucket(n)
         for name, dt in _REG_DTYPES.items():
-            attr = _FIELD_ATTRS[name]
             col = np.full(self.n_pad, _PAD_VALUES[name], dtype=dt)
-            col[:n] = [getattr(v, attr) for v in vs]
+            col[:n] = [_field_value(v, name) for v in vs]
             self.shadow[name] = col
             self.device[name] = self._put(col)
         j = journal_of(state)
@@ -154,9 +205,8 @@ class RegistryMirror:
     def _apply_rows(self, vs, rows: list[int]) -> None:
         idx = np.asarray(rows, dtype=np.int64)
         for name, dt in _REG_DTYPES.items():
-            attr = _FIELD_ATTRS[name]
             vals = np.asarray(
-                [getattr(vs[i], attr) for i in rows], dtype=dt
+                [_field_value(vs[i], name) for i in rows], dtype=dt
             )
             self.shadow[name][idx] = vals
             self.device[name] = (

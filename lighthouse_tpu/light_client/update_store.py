@@ -116,19 +116,45 @@ class LightClientUpdateStore:
 
     # -- persistence --------------------------------------------------------
 
+    def _decode_frame(self, value: bytes):
+        fork = _FORK_ORDER[value[0]]
+        cls = light_client_types(
+            self.spec.preset.name, fork
+        ).LightClientUpdate
+        return cls.decode(value[1:])
+
     def _restore(self) -> None:
         for key, value in self._kv.iter_column(DBColumn.LightClientUpdate):
             if len(key) != 8 or not value:
                 continue
             period = struct.unpack(">Q", key)[0]
-            fork = _FORK_ORDER[value[0]]
-            cls = light_client_types(
-                self.spec.preset.name, fork
-            ).LightClientUpdate
             try:
-                self._best[period] = cls.decode(value[1:])
+                self._best[period] = self._decode_frame(value)
             except Exception:  # noqa: BLE001 — a bad row is skipped, not fatal
                 continue
+
+    def _load(self, period: int):
+        """Read-through backfill: a period absent from the hot map (pruned
+        to bound memory, or skipped by a partial restore) is fetched from
+        its persisted KV frame and re-cached, so ``updates_by_range``
+        serves the full archive over both HTTP and Req/Resp."""
+        if self._kv is None:
+            return None
+        value = self._kv.get(
+            DBColumn.LightClientUpdate, struct.pack(">Q", int(period))
+        )
+        if not value:
+            return None
+        try:
+            update = self._decode_frame(value)
+        except Exception:  # noqa: BLE001 — a bad row serves nothing
+            return None
+        self._best[int(period)] = update
+        return update
+
+    def _get(self, period: int):
+        u = self._best.get(int(period))
+        return u if u is not None else self._load(period)
 
     def _persist(self, period: int, update) -> None:
         if self._kv is None:
@@ -155,7 +181,8 @@ class LightClientUpdateStore:
         period = sync_committee_period(
             self.spec, int(update.attested_header.beacon.slot)
         )
-        old = self._best.get(period)
+        # read-through: a pruned period's persisted incumbent still ranks
+        old = self._get(period)
         if old is not None and not is_better_update(self.spec, update, old):
             return False
         self._best[period] = update
@@ -167,15 +194,27 @@ class LightClientUpdateStore:
     def get_updates(self, start_period: int, count: int) -> list:
         """Best updates for ``[start_period, start_period + count)`` —
         periods with no update are skipped (the API contract: the response
-        carries what the server holds, in period order)."""
-        return [
-            self._best[p]
-            for p in range(int(start_period), int(start_period) + int(count))
-            if p in self._best
-        ]
+        carries what the server holds, in period order). Periods missing
+        from the hot map read through to their persisted KV frames."""
+        out = []
+        for p in range(int(start_period), int(start_period) + int(count)):
+            u = self._get(p)
+            if u is not None:
+                out.append(u)
+        return out
 
     def best(self, period: int):
-        return self._best.get(int(period))
+        return self._get(int(period))
+
+    def prune_hot(self, keep: int) -> int:
+        """Evict all but the newest ``keep`` periods from the hot map. The
+        KV frames stay — serving reads pruned periods back through
+        ``_load`` on demand. Returns the number of evicted periods."""
+        periods = sorted(self._best)
+        evict = periods[: max(len(periods) - max(int(keep), 0), 0)]
+        for p in evict:
+            del self._best[p]
+        return len(evict)
 
     def known_periods(self) -> list[int]:
         return sorted(self._best)

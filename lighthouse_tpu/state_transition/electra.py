@@ -170,6 +170,10 @@ def switch_to_compounding_validator(spec, state, index: int) -> None:
     v.withdrawal_credentials = (
         COMPOUNDING_WITHDRAWAL_PREFIX + bytes(v.withdrawal_credentials)[1:]
     )
+    # the credential prefix feeds the mirror's derived "compounding" column
+    from ..epoch_engine import mark_registry_delta
+
+    mark_registry_delta(state, index)
     queue_excess_active_balance(spec, state, index)
 
 
@@ -304,6 +308,9 @@ def process_consolidation_request(spec, state, request, ctxt=None) -> None:
     )
     source.exit_epoch = exit_epoch
     source.withdrawable_epoch = exit_epoch + spec.min_validator_withdrawability_delay
+    from ..epoch_engine import mark_registry_delta
+
+    mark_registry_delta(state, source_index)
     state.pending_consolidations = list(state.pending_consolidations) + [
         ns.PendingConsolidation(
             source_index=source_index, target_index=target_index

@@ -39,6 +39,11 @@ def _restore_backend():
 def _spec(fork: str):
     if fork == "phase0":
         return minimal_spec()
+    if fork == "electra":
+        return minimal_spec(
+            altair_fork_epoch=0, bellatrix_fork_epoch=0,
+            capella_fork_epoch=0, deneb_fork_epoch=0, electra_fork_epoch=0,
+        )
     return minimal_spec(altair_fork_epoch=0)
 
 
@@ -168,6 +173,7 @@ _REG_FIELDS = (
 
 
 def _assert_field_parity(a, b, fork):
+    assert len(a.validators) == len(b.validators)
     np.testing.assert_array_equal(
         np.asarray(a.balances), np.asarray(b.balances)
     )
@@ -191,6 +197,15 @@ def _assert_field_parity(a, b, fork):
         np.testing.assert_array_equal(
             np.asarray(a.inactivity_scores), np.asarray(b.inactivity_scores)
         )
+    if fork == "electra":
+        for f in (
+            "deposit_balance_to_consume",
+            "exit_balance_to_consume",
+            "earliest_exit_epoch",
+        ):
+            assert int(getattr(a, f)) == int(getattr(b, f)), f
+        assert len(a.pending_deposits) == len(b.pending_deposits)
+        assert len(a.pending_consolidations) == len(b.pending_consolidations)
     assert a.tree_root() == b.tree_root()
 
 
@@ -251,6 +266,376 @@ def test_genesis_epoch_boundary_parity():
     spec = _spec("altair")
     state = _random_state(spec, "altair", 3, cur_epoch=1)
     _run_both(spec, state, "altair")
+
+
+# ---------------------------------------------------------------------------
+# Electra family: EIP-7251 balance churn + pending deposit/consolidation queues
+# ---------------------------------------------------------------------------
+
+_SIG96 = b"\xc0" + b"\x00" * 95  # G2 infinity: never verified for known keys
+
+
+def _electra_state(spec, seed: int, cur_epoch: int = 4,
+                   deposits: bool = True, consolidations: bool = True):
+    """Randomized electra state with the EIP-7251 edge cases staged:
+    compounding/eth1/bls credential mixes, non-zero churn carries, a
+    pending-deposit queue that straddles the activation-exit budget (with
+    withdrawn-free and exiting-postponed targets), and a consolidation
+    queue with slashed-skipped, chained, and not-yet-withdrawable sources."""
+    rng = np.random.default_rng(seed + 9000)
+    state = _random_state(spec, "electra", seed, cur_epoch=cur_epoch)
+    assert state.fork_name == "electra"
+    ns = for_preset(spec.preset.name)
+    n = len(state.validators)
+    # credential mix (genesis is all-0x00 BLS): the compounding plane drives
+    # the per-validator max_effective_balance in the hysteresis stage
+    for i, v in enumerate(state.validators):
+        r = rng.random()
+        if r < 0.30:
+            v.withdrawal_credentials = (
+                b"\x02" + bytes(v.withdrawal_credentials)[1:]
+            )
+            if rng.random() < 0.5:  # above the 32 ETH floor: cap matters
+                state.balances[i] = int(rng.integers(33, 120)) * 10**9
+        elif r < 0.60:
+            v.withdrawal_credentials = (
+                b"\x01" + bytes(v.withdrawal_credentials)[1:]
+            )
+    # churn carries: earliest_exit straddles cur+1+lookahead so both the
+    # reset-to-churn and carried-balance branches of
+    # compute_exit_epoch_and_update_churn get exercised across seeds
+    state.deposit_requests_start_index = 0  # EL bridge caught up: gate open
+    state.deposit_balance_to_consume = int(rng.integers(0, 2 * 10**9))
+    state.earliest_exit_epoch = cur_epoch + int(rng.integers(0, 8))
+    state.exit_balance_to_consume = int(rng.integers(0, 64 * 10**9))
+
+    if deposits:
+        wd_i, exit_i = 70, 71
+        v = state.validators[wd_i]  # withdrawn: deposit applies churn-free
+        v.slashed = False
+        v.exit_epoch = max(cur_epoch - 2, 1)
+        v.withdrawable_epoch = cur_epoch  # < next_epoch
+        v = state.validators[exit_i]  # exiting: deposit postponed
+        v.slashed = False
+        v.exit_epoch = cur_epoch + 2
+        v.withdrawable_epoch = (
+            cur_epoch + 2 + spec.min_validator_withdrawability_delay
+        )
+
+        def dep(i, amount, slot=0):
+            v = state.validators[i]
+            return ns.PendingDeposit(
+                pubkey=bytes(v.pubkey),
+                withdrawal_credentials=bytes(v.withdrawal_credentials),
+                amount=amount, signature=_SIG96, slot=slot,
+            )
+
+        q = [dep(wd_i, 7 * 10**9), dep(exit_i, 5 * 10**9)]
+        # ~8 more consuming entries of 24-40 ETH against a ~128 ETH budget:
+        # the churn break lands mid-queue (partially-consumable queue)
+        for _ in range(8):
+            q.append(
+                dep(int(rng.integers(0, n)),
+                    int(rng.integers(24, 40)) * 10**9)
+            )
+        state.pending_deposits = q
+
+    if consolidations:
+        src_a, src_b, tgt, src_slashed, src_late = 80, 81, 82, 83, 84
+        for i, wd in ((src_a, cur_epoch - 1), (src_b, cur_epoch)):
+            v = state.validators[i]  # withdrawable: consolidation executes
+            v.slashed = False
+            v.exit_epoch = 1
+            v.withdrawable_epoch = wd  # <= next_epoch
+        v = state.validators[src_slashed]  # slashed: skipped-but-consumed
+        v.slashed = True
+        v.exit_epoch = max(cur_epoch - 1, 1)
+        v.withdrawable_epoch = cur_epoch + 40
+        v = state.validators[src_late]  # still in delay: stops the sweep
+        v.slashed = False
+        v.exit_epoch = cur_epoch + 1
+        v.withdrawable_epoch = (
+            cur_epoch + 1 + spec.min_validator_withdrawability_delay
+        )
+        v = state.validators[tgt]
+        v.withdrawal_credentials = (
+            b"\x02" + bytes(v.withdrawal_credentials)[1:]
+        )
+        state.pending_consolidations = [
+            # a -> b then b -> tgt: order-dependent chained balances
+            ns.PendingConsolidation(source_index=src_a, target_index=src_b),
+            ns.PendingConsolidation(
+                source_index=src_slashed, target_index=tgt
+            ),
+            ns.PendingConsolidation(source_index=src_b, target_index=tgt),
+            ns.PendingConsolidation(source_index=src_late, target_index=tgt),
+            # unreachable past the stop: must survive in the queue
+            ns.PendingConsolidation(source_index=src_a, target_index=tgt),
+        ]
+    return state
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_electra_parity_randomized(seed):
+    spec = _spec("electra")
+    _run_both(spec, _electra_state(spec, seed), "electra")
+
+
+def test_electra_churn_boundary_parity():
+    """One deposit exactly at the remaining budget (fits: strict `>` in the
+    churn test) and a twin one gwei over (breaks the sweep): both states
+    must match numpy, including the deposit_balance_to_consume carry-out."""
+    spec = _spec("electra")
+    from lighthouse_tpu.state_transition.electra import (
+        get_activation_exit_churn_limit,
+    )
+
+    for overshoot in (0, 1):
+        state = _electra_state(
+            spec, seed=11, deposits=False, consolidations=False
+        )
+        ns = for_preset(spec.preset.name)
+        budget = int(state.deposit_balance_to_consume) + (
+            get_activation_exit_churn_limit(spec, state)
+        )
+        v = state.validators[5]  # pinned active: the deposit must consume
+        v.slashed = False
+        v.exit_epoch = FAR_FUTURE_EPOCH
+        v.withdrawable_epoch = FAR_FUTURE_EPOCH
+        state.pending_deposits = [
+            ns.PendingDeposit(
+                pubkey=bytes(v.pubkey),
+                withdrawal_credentials=bytes(v.withdrawal_credentials),
+                amount=budget + overshoot, signature=_SIG96, slot=0,
+            )
+        ]
+        _run_both(spec, state, "electra")
+
+
+def test_electra_deposit_finality_and_bridge_gates():
+    """A not-yet-finalized deposit slot halts the queue mid-way; separately,
+    an unfinished EIP-6110 bridge transition halts every slot>0 deposit."""
+    spec = _spec("electra")
+    ns = for_preset(spec.preset.name)
+    # finality gate: entry 2 has slot far past any reachable finalized slot
+    state = _electra_state(spec, seed=13, consolidations=False)
+    q = list(state.pending_deposits)
+    v = state.validators[9]
+    q.insert(2, ns.PendingDeposit(
+        pubkey=bytes(v.pubkey),
+        withdrawal_credentials=bytes(v.withdrawal_credentials),
+        amount=3 * 10**9, signature=_SIG96,
+        slot=spec.start_slot(20),
+    ))
+    state.pending_deposits = q
+    _run_both(spec, state, "electra")
+    # bridge gate: requests start index beyond the eth1 deposit cursor
+    state = _electra_state(spec, seed=14, consolidations=False)
+    state.deposit_requests_start_index = (
+        int(state.eth1_deposit_index) + 100
+    )
+    q = list(state.pending_deposits)
+    for i, d in enumerate(q[3:], start=3):  # tail entries became EL requests
+        d.slot = 1
+    state.pending_deposits = q
+    _run_both(spec, state, "electra")
+
+
+def test_electra_unknown_pubkey_deposits_append():
+    """Unknown-pubkey deposits are the host's half of the split: a valid
+    proof-of-possession appends a validator, a second deposit for the same
+    new pubkey resolves to the appended index, an invalid signature is
+    dropped — but every one of them consumes churn budget."""
+    from lighthouse_tpu import bls
+    from lighthouse_tpu.state_transition.genesis import interop_secret_keys
+    from lighthouse_tpu.types.containers import DepositMessage
+    from lighthouse_tpu.types.helpers import (
+        compute_domain,
+        compute_signing_root,
+    )
+
+    prev = bls.get_backend()
+    bls.set_backend("native")
+    try:
+        spec = _spec("electra")
+        ns = for_preset(spec.preset.name)
+        state = _electra_state(
+            spec, seed=17, deposits=False, consolidations=False
+        )
+        sks = [
+            bls.SecretKey.from_bytes(x.to_bytes(32, "big"))
+            for x in interop_secret_keys(N_VALIDATORS + 2)
+        ][-2:]
+        domain = compute_domain(
+            spec.DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+        )
+
+        def signed(sk, amount, prefix, valid=True):
+            pk = sk.public_key().serialize()
+            wc = prefix + b"\x00" * 31
+            msg = DepositMessage(
+                pubkey=pk, withdrawal_credentials=wc, amount=amount
+            )
+            sig = sk.sign(
+                compute_signing_root(msg, domain)
+                if valid
+                else b"\x99" * 32
+            )
+            return ns.PendingDeposit(
+                pubkey=pk, withdrawal_credentials=wc, amount=amount,
+                signature=sig.serialize(), slot=0,
+            )
+
+        v = state.validators[3]
+        state.pending_deposits = [
+            signed(sks[0], 40 * 10**9, b"\x02"),  # appends (compounding)
+            signed(sks[0], 12 * 10**9, b"\x02"),  # tops up the appended row
+            signed(sks[1], 32 * 10**9, b"\x00", valid=False),  # dropped
+            ns.PendingDeposit(  # known validator after the appends
+                pubkey=bytes(v.pubkey),
+                withdrawal_credentials=bytes(v.withdrawal_credentials),
+                amount=2 * 10**9, signature=_SIG96, slot=0,
+            ),
+        ]
+        _run_both(spec, state, "electra")
+    finally:
+        bls.set_backend(prev)
+
+
+def test_electra_parity_under_inactivity_leak():
+    spec = _spec("electra")
+    state = _electra_state(spec, seed=19, cur_epoch=7)
+    state.finalized_checkpoint = Checkpoint(epoch=0, root=b"\x11" * 32)
+    _run_both(spec, state, "electra")
+
+
+def test_electra_genesis_epoch_boundary_parity():
+    spec = _spec("electra")
+    state = _electra_state(spec, seed=23, cur_epoch=1)
+    _run_both(spec, state, "electra")
+
+
+def test_electra_multi_epoch_roll_parity():
+    """Three consecutive boundaries: the dbtc / exit-churn carries, the
+    postponed deposits re-entering the queue, and the trimmed consolidation
+    queue must all round-trip through the scalar outputs."""
+    spec = _spec("electra")
+    state = _electra_state(spec, seed=5)
+    twin = state.copy()
+    spe = spec.preset.SLOTS_PER_EPOCH
+    epoch_engine.set_backend("device")
+    for _ in range(3):
+        assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+        state.slot += spe
+    epoch_engine.set_backend("numpy")
+    for _ in range(3):
+        process_epoch(spec, twin)
+        twin.slot += spe
+    _assert_field_parity(twin, state, "electra")
+
+
+def test_electra_zero_steady_state_recompiles():
+    """Queue depths change every epoch; the fixed deposit-column shape and
+    the consolidation shape bucket must keep the jit cache warm."""
+    from lighthouse_tpu.epoch_engine import kernels
+
+    spec = _spec("electra")
+    state = _electra_state(spec, seed=29)
+    spe = spec.preset.SLOTS_PER_EPOCH
+    epoch_engine.set_backend("device")
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)  # warm
+    f = kernels._compiled(kernels.consts_for(spec, "electra"))
+    if not hasattr(f, "_cache_size"):
+        pytest.skip("jit cache introspection unavailable on this jax")
+    warm = f._cache_size()
+    for _ in range(2):
+        state.slot += spe
+        assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+    assert f._cache_size() == warm
+
+
+def test_electra_mirror_delta_and_compounding_journal():
+    """switch_to_compounding_validator between boundaries flips the derived
+    compounding column (a withdrawal_credentials rewrite): the journal mark
+    must carry it through a delta sync, not a rebind."""
+    from lighthouse_tpu.state_transition.electra import (
+        switch_to_compounding_validator,
+    )
+
+    spec = _spec("electra")
+    state = _electra_state(spec, seed=37)
+    # index 8: guaranteed eth1-credential active validator with excess
+    v = state.validators[8]
+    v.withdrawal_credentials = b"\x01" + bytes(v.withdrawal_credentials)[1:]
+    v.slashed = False
+    v.exit_epoch = FAR_FUTURE_EPOCH
+    state.balances[8] = 40 * 10**9
+    twin = state.copy()
+    spe = spec.preset.SLOTS_PER_EPOCH
+
+    epoch_engine.set_backend("device")
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+    state.slot += spe
+    switch_to_compounding_validator(spec, state, 8)
+    assert epoch_engine.maybe_process_epoch_on_device(spec, state)
+    stats = epoch_engine.engine_stats(state)
+    assert stats["full_syncs"] == 1, stats
+    assert stats["delta_syncs"] == 1, stats
+
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, twin)
+    twin.slot += spe
+    switch_to_compounding_validator(spec, twin, 8)
+    process_epoch(spec, twin)
+    _assert_field_parity(twin, state, "electra")
+
+
+def test_electra_lossless_demotion_under_injected_fault():
+    """A faulted sweep must leave the state byte-identical (the engine
+    materializes every output inside the supervised region before any host
+    write), so the numpy path can own the boundary losslessly."""
+    from lighthouse_tpu import resilience
+    from lighthouse_tpu.resilience.inject import injector
+
+    spec = _spec("electra")
+    state = _electra_state(spec, seed=31)
+    twin = state.copy()
+    sup = resilience.epoch_supervisor()
+    sup.reset()
+    root_before = state.tree_root()
+    injector.install("stage=epoch.sweep;mode=raise;kind=oom;at=1")
+    try:
+        epoch_engine.set_backend("device")
+        assert not epoch_engine.maybe_process_epoch_on_device(spec, state)
+        assert state.tree_root() == root_before  # byte-identical demotion
+    finally:
+        injector.clear()
+        sup.reset()
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, state)
+    process_epoch(spec, twin)
+    _assert_field_parity(twin, state, "electra")
+
+
+def test_electra_sharded_sweep_matches_numpy():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from lighthouse_tpu.epoch_engine.engine import process_epoch_on_device
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must expose 8 virtual CPU devices"
+    mesh = Mesh(np.array(devs[:8]), axis_names=("validators",))
+    sharding = NamedSharding(mesh, PartitionSpec("validators"))
+
+    spec = _spec("electra")
+    state = _electra_state(spec, seed=41)
+    twin = state.copy()
+    epoch_engine.set_backend("device")
+    assert process_epoch_on_device(spec, state, sharding=sharding)
+    epoch_engine.set_backend("numpy")
+    process_epoch(spec, twin)
+    _assert_field_parity(twin, state, "electra")
 
 
 # ---------------------------------------------------------------------------
@@ -380,3 +765,50 @@ def test_sharded_sweep_matches_numpy():
     epoch_engine.set_backend("numpy")
     process_epoch(spec, twin)
     _assert_field_parity(twin, state, "altair")
+
+
+# ---------------------------------------------------------------------------
+# Analysis registration: the electra sweep is a certified op graph
+# ---------------------------------------------------------------------------
+
+
+class TestBoundsRegistration:
+    def test_electra_sweep_graph_registered_and_proven(self):
+        from lighthouse_tpu.analysis import bounds
+
+        cert = bounds.certify(backends=("f64",), batches=(1,),
+                              graphs=["epoch.sweep_electra"])
+        assert cert["ok"], [r for r in cert["obligations"] if not r["ok"]]
+        assert any(
+            "epoch.sweep_electra" in r["graph"] for r in cert["obligations"]
+        )
+        kinds = {r["kind"] for r in cert["obligations"]}
+        assert {
+            "epoch_validator_index_domain",
+            "epoch_churn_cumsum_headroom",
+            "epoch_deposit_plane_width",
+        } <= kinds
+
+    def test_blown_churn_headroom_fails_certification(self):
+        """Seeded mutation: a max-effective cap large enough to wrap the
+        u64 balance prefix sums must fail the certificate — the obligation
+        is live, not decorative."""
+        import functools
+
+        from lighthouse_tpu.analysis import bounds
+        from lighthouse_tpu.epoch_engine import kernels
+
+        entry = next(
+            e for e in bounds.graph_registry(1)
+            if e[0] == "epoch.sweep_electra"
+        )
+        good_consts = entry[1].args[0]
+        bad = functools.partial(
+            kernels._sweep_electra,
+            good_consts._replace(max_effective_balance_electra=2**60),
+        )
+        rows = bounds.certify_callable(bad, entry[2], backend="f64")
+        failed = [r for r in rows if not r["ok"]]
+        assert failed and any(
+            r["kind"] == "epoch_churn_cumsum_headroom" for r in failed
+        )

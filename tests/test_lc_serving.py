@@ -407,3 +407,98 @@ class TestPeriodBoundary:
                 engine.set_lc_backend(prev)
         finally:
             net.stop()
+
+
+# -- read-through backfill: pruned hot map served from persisted KV frames ---------
+
+
+class TestReadThroughBackfill:
+    def test_pruned_hot_map_reads_through_kv(self):
+        kv = MemoryStore()
+        store = LightClientUpdateStore(SPEC, kv)
+        slots_per_period = (
+            SPEC.preset.SLOTS_PER_EPOCH
+            * SPEC.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        att = 2 * slots_per_period + 1
+        u0 = mk_update(25, att_slot=3, sig_slot=4)
+        u2 = mk_update(25, att_slot=att, sig_slot=att + 1)
+        assert store.consider(u0)
+        assert store.consider(u2)
+        assert store.prune_hot(1) == 1
+        assert store.known_periods() == [2]
+        # the pruned period still serves, from its persisted frame
+        got = store.get_updates(0, 4)
+        assert [int(u.attested_header.beacon.slot) for u in got] == [3, att]
+        # ...and the read-through re-cached it
+        assert store.known_periods() == [0, 2]
+        store.prune_hot(0)
+        assert store.best(2).serialize() == u2.serialize()
+        # ranking still sees the persisted incumbent for a pruned period
+        store.prune_hot(0)
+        assert not store.consider(mk_update(10, att_slot=3, sig_slot=4))
+        # a memory-only store has nothing to read through
+        mem = LightClientUpdateStore(SPEC, None)
+        mem.consider(u0)
+        assert mem.prune_hot(0) == 1
+        assert mem.get_updates(0, 4) == []
+
+    def test_pruned_periods_served_over_reqresp_and_http(self, tmp_path):
+        """One durable-datadir node crosses a sync-committee rollover, its
+        hot map is pruned to nothing, and BOTH serving transports — the
+        Req/Resp UpdatesByRange method and the Beacon API HTTP endpoint —
+        still return the full archive via the KV read-through."""
+        import json
+        import urllib.request
+
+        from lighthouse_tpu.http_api import BeaconApiServer
+
+        spec = dataclasses.replace(
+            SPEC,
+            preset=dataclasses.replace(
+                SPEC.preset, EPOCHS_PER_SYNC_COMMITTEE_PERIOD=2
+            ),
+        )
+        net = LocalNetwork(
+            spec, 1, 16, sync_committee=True, datadir=str(tmp_path)
+        )
+        try:
+            net.run_until(20)
+            node = net.nodes[0]
+            store = node.chain.light_client_cache.update_store
+            assert store._kv is not None, "datadir node must be KV-backed"
+            assert store.known_periods() == [0, 1]
+
+            assert store.prune_hot(0) == 2
+            assert store.known_periods() == []
+            ups = net.transport.request(
+                "client", "node_0", "light_client_updates_by_range", (0, 4)
+            )
+            assert [
+                sync_committee_period(spec, int(u.signature_slot))
+                for u in ups
+            ] == [0, 1]
+
+            assert store.prune_hot(0) == 2
+            server = BeaconApiServer(node.chain).start()
+            try:
+                with urllib.request.urlopen(
+                    server.url
+                    + "/eth/v1/beacon/light_client/updates"
+                    + "?start_period=0&count=4"
+                ) as r:
+                    res = json.loads(r.read().decode())
+            finally:
+                server.stop()
+            frames = res["data"] if isinstance(res, dict) else res
+            assert len(frames) == 2
+            decoded = [
+                LC.LightClientUpdate.decode(bytes.fromhex(f[2:]))
+                for f in frames
+            ]
+            assert [
+                sync_committee_period(spec, int(u.signature_slot))
+                for u in decoded
+            ] == [0, 1]
+        finally:
+            net.stop()

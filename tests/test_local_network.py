@@ -140,3 +140,87 @@ def test_slasher_gossip_to_block_inclusion():
             if val.slashed
         ]
         assert slashed == [v], slashed
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_proposer_equivocation_surveillance():
+    """Proposer-equivocation surveillance (ISSUE 19 satellite): the duty
+    proposer signs TWO valid blocks for its slot (distinct graffiti ->
+    distinct header roots, both genuinely signed) -> both imports fire every
+    node's slasher ``block_observed`` seam -> the engine's (slot, proposer)
+    proposal index convicts the double proposal -> the ProposerSlashing
+    drains into the op pool on the next tick -> a later proposal includes
+    it -> the equivocator ends up slashed on EVERY node. Honest traffic all
+    the while produces zero false positives."""
+    from lighthouse_tpu.ssz import uint64
+    from lighthouse_tpu.state_transition import (
+        get_beacon_proposer_index,
+        get_current_epoch,
+        process_slots,
+    )
+    from lighthouse_tpu.types.containers import SigningData
+    from lighthouse_tpu.types.helpers import compute_signing_root, get_domain
+
+    spec = minimal_spec()
+    net = LocalNetwork(spec, n_nodes=2, n_validators=16, slasher=True)
+    net.run_until(4)
+    assert net.heads_agree()
+
+    # craft the equivocation: the slot-5 duty proposer double-signs
+    slot = 5
+    net.clock.set_slot(slot)
+    state = net.nodes[0].chain.head.state.copy()
+    if state.slot < slot:
+        process_slots(spec, state, slot)
+    proposer = get_beacon_proposer_index(spec, state)
+    node = net._owner_of(proposer)
+    epoch = get_current_epoch(spec, state)
+    domain_r = get_domain(spec, state, spec.DOMAIN_RANDAO, epoch=epoch)
+    reveal = net.harness._sign(
+        proposer,
+        SigningData(
+            object_root=uint64.hash_tree_root(epoch), domain=domain_r
+        ).tree_root(),
+    )
+    domain_b = get_domain(spec, state, spec.DOMAIN_BEACON_PROPOSER, epoch=epoch)
+    block_cls = node.chain.ns.block_types[spec.fork_name_at_epoch(epoch)]
+
+    def double_sign(graffiti: bytes):
+        block, _post = node.chain.produce_block_on_state(
+            node.chain.head.state, slot, reveal,
+            graffiti=graffiti.ljust(32, b"\x00"),
+        )
+        sig = net.harness._sign(proposer, compute_signing_root(block, domain_b))
+        return block_cls(message=block, signature=sig)
+
+    for signed in (double_sign(b"canonical"), double_sign(b"equivocation")):
+        node.chain.process_block(signed)
+        node.publish_block(signed)
+        net._msg_total += 1
+    net.settle()
+
+    # the PEER's slasher saw both imports through its block_observed seam:
+    # tick -> the (slot, proposer) proposal index convicts -> op pool
+    peer = net.nodes[1]
+    stats = peer.slasher_service.tick(current_epoch=epoch)
+    assert stats["proposer_slashings"] >= 1, stats
+    assert len(peer.op_pool._proposer_slashings) >= 1
+
+    # keep the network running: the conviction rides a later proposal
+    for s in range(slot + 1, slot + 9):
+        net.run_slot(s)
+        if all(
+            bool(n.chain.head.state.validators[proposer].slashed)
+            for n in net.nodes
+        ):
+            break
+    else:
+        raise AssertionError("equivocator never slashed on all nodes")
+    # zero false positives: only the equivocating proposer got slashed
+    for n in net.nodes:
+        slashed = [
+            i for i, val in enumerate(n.chain.head.state.validators)
+            if val.slashed
+        ]
+        assert slashed == [proposer], slashed

@@ -13,6 +13,7 @@ Usage: python tools_compile_probe.py [--json] [n_sets] [k_keys] [stage ...]
 
 from __future__ import annotations
 
+import functools
 import json
 import sys
 import time
@@ -161,6 +162,65 @@ def main():
             agg_sums_per_batch_trace=(
                 lcv.PROBE["agg_sums"] - before["agg_sums"]
             ),
+        )
+    if want("epoch"):
+        # fused epoch sweeps (ISSUE 19): one compiled graph per fork family
+        # at a fixed 4096-validator bucket (the engine's pow2 bucketing means
+        # any state between 2049 and 4096 validators reuses this program;
+        # the fixed 16/8 queue planes are why electra rolls never recompile)
+        from lighthouse_tpu.epoch_engine import kernels as ek
+        from lighthouse_tpu.types.spec import mainnet_spec
+
+        nv = 4096
+        v64 = jnp.zeros((nv,), dtype=jnp.uint64)
+        vbool = jnp.zeros((nv,), dtype=bool)
+        v8 = jnp.zeros((nv,), dtype=jnp.uint8)
+        s64 = jnp.zeros((), dtype=jnp.uint64)
+        base_cols = {
+            "effective": v64, "slashed": vbool, "activation": v64,
+            "exit": v64, "withdrawable": v64, "eligibility": v64,
+            "balances": v64, "inactivity": v64,
+            "prev_part": v8, "cur_part": v8,
+        }
+        base_scalars = {
+            "cur_epoch": s64, "finalized_epoch": s64,
+            "prev_justified_epoch": s64, "cur_justified_epoch": s64,
+            "bits": jnp.zeros((4,), dtype=bool), "slash_sum": s64,
+        }
+        forks0 = dict(
+            altair_fork_epoch=0, bellatrix_fork_epoch=0,
+            capella_fork_epoch=0, deneb_fork_epoch=0,
+        )
+        spec_a = mainnet_spec(**forks0)
+        probe(
+            "epoch.sweep_altair",
+            functools.partial(ek._sweep_altair, ek.consts_for(spec_a, "altair")),
+            base_cols, base_scalars,
+        )
+        spec_e = mainnet_spec(electra_fork_epoch=0, **forks0)
+        electra_cols = dict(
+            base_cols,
+            compounding=vbool,
+            dep_amount=jnp.zeros((16,), dtype=jnp.uint64),
+            dep_slot=jnp.zeros((16,), dtype=jnp.uint64),
+            dep_index=jnp.zeros((16,), dtype=jnp.int32),
+            dep_valid=jnp.zeros((16,), dtype=bool),
+            con_src=jnp.zeros((8,), dtype=jnp.int32),
+            con_tgt=jnp.zeros((8,), dtype=jnp.int32),
+            con_valid=jnp.zeros((8,), dtype=bool),
+        )
+        electra_scalars = dict(
+            base_scalars,
+            earliest_exit_epoch=s64, exit_balance_to_consume=s64,
+            deposit_balance_to_consume=s64, eth1_deposit_index=s64,
+            deposit_requests_start_index=s64,
+        )
+        probe(
+            "epoch.sweep_electra",
+            functools.partial(
+                ek._sweep_electra, ek.consts_for(spec_e, "electra")
+            ),
+            electra_cols, electra_scalars,
         )
     if want("finalexp"):
         probe(

@@ -313,6 +313,15 @@ def persist(rec: dict, rung_idx: int) -> None:
     # bench._hunter_record resolves across all suffixes.
     impl = rec.get("conv_impl") or "unstamped"
     record_path = record_path[: -len(".json")] + f".{impl}.json"
+    # ISSUE 19: records that measured a specific fork family (the epoch
+    # rungs: altair vs electra sweep different kernels — the electra family
+    # adds the pending-deposit scatter + consolidation scan stages) are
+    # ALSO keyed by the fork stamp, so an electra record never overwrites
+    # the altair A/B baseline silently. Fork-less records (every other
+    # metric) keep their unsuffixed names.
+    fork = (rec.get("shape") or {}).get("fork")
+    if fork:
+        record_path = record_path[: -len(".json")] + f".{fork}.json"
     best = None
     try:
         with open(record_path) as f:
