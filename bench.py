@@ -188,6 +188,7 @@ def run_inner(
         env["BENCH_FALLBACK"] = "1"
     else:
         env.pop("BENCH_FALLBACK", None)
+    rss_before = _children_peak_rss_bytes()
     try:
         with bench_lock(max_wait=1800.0):
             out = subprocess.run(
@@ -212,9 +213,51 @@ def run_inner(
             + out.stderr.decode(errors="replace")[-300:].strip()
         )
     try:
-        return json.loads(json_lines[-1]), "ok"
+        rec = json.loads(json_lines[-1])
     except ValueError:
         return None, f"shape ({sets}x{keys}) emitted unparseable JSON"
+    _stamp_memory(rec, mode, sets, keys, validators, batch, rss_before)
+    return rec, "ok"
+
+
+def _children_peak_rss_bytes() -> int | None:
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_CHILDREN)
+        return int(ru.ru_maxrss) * 1024  # linux reports KiB
+    except Exception:  # noqa: BLE001 — the stamp must never fail a record
+        return None
+
+
+def _stamp_memory(rec, mode, sets, keys, validators, batch, rss_before):
+    """Predicted-vs-actual memory block on every rung record (ISSUE 20):
+    the static planner's predicted peak bytes for this rung's shape beside
+    the measured peak RSS of the inner subprocess that just ran it, so
+    model drift is visible in every BENCH_*.json / hunter record."""
+    try:
+        from lighthouse_tpu.analysis import memory as amem
+
+        tier = os.environ.get("HUNTER_MEMORY_TIER", amem.DEFAULT_TIER)
+        fit = amem.rung_fit(
+            mode, sets, keys, validators, batch,
+            tier=tier, cert=amem._load_cert(),
+        )
+        rss_after = _children_peak_rss_bytes()
+        mem = {
+            "predicted_peak_bytes": fit["predicted_bytes"],
+            "predicted_resident_bytes": fit["resident_bytes"],
+            "tier": fit["tier"],
+            "tier_margin_bytes": fit["margin_bytes"],
+            "child_peak_rss_bytes": rss_after,
+        }
+        # ru_maxrss is a high-water mark across ALL children: the delta is
+        # only attributable to this subprocess when it set a new high
+        if rss_before is not None and rss_after is not None:
+            mem["child_peak_rss_delta_bytes"] = max(0, rss_after - rss_before)
+        rec["memory"] = mem
+    except Exception:  # noqa: BLE001 — the stamp must never fail a record
+        pass
 
 
 def probe_once(timeout: float) -> tuple[str | None, str]:
@@ -541,7 +584,29 @@ def _backend_stamp() -> dict:
 
         from lighthouse_tpu.ops.bls import fq
 
-        return {"conv_impl": fq.conv_backend(), "jax_version": jax.__version__}
+        stamp = {
+            "conv_impl": fq.conv_backend(),
+            "jax_version": jax.__version__,
+        }
+        try:
+            # device-side allocator stats when the runtime exposes them
+            # (TPU/GPU; CPU returns None/raises) — ISSUE 20's measured
+            # counterpart to the cert's predicted peak bytes
+            ms = jax.devices()[0].memory_stats()
+            if ms:
+                stamp["device_memory_stats"] = {
+                    k: int(v)
+                    for k, v in ms.items()
+                    if k in (
+                        "bytes_in_use",
+                        "peak_bytes_in_use",
+                        "bytes_limit",
+                        "largest_alloc_size",
+                    )
+                }
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            pass
+        return stamp
     except Exception:  # noqa: BLE001 — the stamp must never fail a record
         return {"conv_impl": "unknown", "jax_version": "unknown"}
 

@@ -1,7 +1,7 @@
 """Static-analysis subsystem: machine-checked kernel + concurrency
 certification.
 
-Five passes, run in tier-1 CI (``tests/test_analysis.py``), by the TPU
+Six passes, run in tier-1 CI (``tests/test_analysis.py``), by the TPU
 window hunter's preflight (``tools_tpu_hunter.py``), and by hand via
 ``python -m lighthouse_tpu.analysis``:
 
@@ -31,6 +31,19 @@ window hunter's preflight (``tools_tpu_hunter.py``), and by hand via
   blocking-call-under-lock rule, and an env-gated runtime lockdep wrapper
   (``LIGHTHOUSE_LOCKDEP=1``) whose observed acquisition orders are merged
   back into the static graph. Emits ``CONCURRENCY_CERT.json``.
+* **Pass 6 — device-memory certifier & footprint planner** (``memory.py``):
+  abstractly re-executes every registry graph under all three conv
+  backends x both batch regimes, recording argument/output/temp/peak
+  bytes per row (``jax.eval_shape`` + a jaxpr liveness walk, with XLA's
+  lowered-computation cost analysis cross-checking a subset); walks every
+  pallas VMEM tile signature against declared per-tier VMEM caps; models
+  the five device-resident subsystem plane families (epoch mirror,
+  slasher spans, LC committee cache, KZG tables, firehose staging) as
+  static ``*_bytes(config)`` functions parity-pinned against real
+  ``device_put`` accounting; and derives ``max_safe_shape(graph, tier)``
+  so the TPU window hunter skips unfittable rungs with a logged verdict.
+  Emits ``MEMORY_CERT.json``; a row that fits no declared finite tier
+  fails the certificate exactly like a tripped bound.
 """
 
 from .bounds import certify, certify_callable, write_cert  # noqa: F401
@@ -40,6 +53,18 @@ from .concurrency import (  # noqa: F401
     merge_observed,
 )
 from .hygiene import lint_tree  # noqa: F401
+from .memory import (  # noqa: F401
+    DEVICE_TIERS,
+    certify_memory,
+    epoch_mirror_bytes,
+    fault_memory_context,
+    firehose_staging_bytes,
+    kzg_table_bytes,
+    lc_committee_cache_bytes,
+    max_safe_shape,
+    rung_fit,
+    slasher_span_bytes,
+)
 from .recompile import (  # noqa: F401
     CompilationSentinel,
     recompile_probe,

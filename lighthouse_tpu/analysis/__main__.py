@@ -1,10 +1,10 @@
-"""``python -m lighthouse_tpu.analysis`` — run the five-pass certifier suite.
+"""``python -m lighthouse_tpu.analysis`` — run the six-pass certifier suite.
 
 Exit code 0 iff every selected pass is clean. ``--json`` emits one machine-
 readable report on stdout (the hunter preflight consumes it); the default
 output is human-oriented. ``--bounds`` / ``--lint`` / ``--recompile`` /
-``--supervisor`` / ``--concurrency`` select individual passes; with no
-selection all five run:
+``--supervisor`` / ``--concurrency`` / ``--memory`` select individual
+passes; with no selection all six run:
 
 1. **bounds** — the static limb-bound certifier (``BOUNDS_CERT.json``);
 2. **lint** — the trace-hygiene linter;
@@ -14,7 +14,12 @@ selection all five run:
 4. **supervisor** — the supervisor-transparency probe;
 5. **concurrency** — the lock-discipline certifier + lock-order deadlock
    graph (``CONCURRENCY_CERT.json``), merging a ``LOCKDEP_OBSERVED.json``
-   runtime graph when one is present (see ``LIGHTHOUSE_LOCKDEP=1``).
+   runtime graph when one is present (see ``LIGHTHOUSE_LOCKDEP=1``);
+6. **memory** — the device-memory certifier & static footprint planner
+   (``MEMORY_CERT.json``): graph footprints under every conv backend x
+   batch regime, pallas VMEM tile walk, the five subsystem residency
+   models, per-tier margins, and the ``max_safe_shape`` planner the
+   hunter's rung gate consumes.
 """
 
 from __future__ import annotations
@@ -51,6 +56,16 @@ def main(argv=None) -> int:
         "deadlock graph + lockdep cross-check)",
     )
     ap.add_argument(
+        "--memory", action="store_true",
+        help="run only the device-memory certifier & footprint planner",
+    )
+    ap.add_argument(
+        "--memory-cert-out",
+        default=None,
+        help="write MEMORY_CERT.json here (default: repo root when the"
+        " memory pass runs, '-' to skip)",
+    )
+    ap.add_argument(
         "--cert-out",
         default=None,
         help="write BOUNDS_CERT.json here (default: repo root when the bounds"
@@ -79,13 +94,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     any_selected = (
         args.bounds or args.lint or args.recompile or args.supervisor
-        or args.concurrency
+        or args.concurrency or args.memory
     )
     run_bounds = args.bounds or not any_selected
     run_lint = args.lint or not any_selected
     run_recompile = args.recompile or not any_selected
     run_supervisor = args.supervisor or not any_selected
     run_concurrency = args.concurrency or not any_selected
+    run_memory = args.memory or not any_selected
 
     report: dict = {"ok": True}
     rc = 0
@@ -232,6 +248,45 @@ def main(argv=None) -> int:
                 f" {cert['n_failed']} failed, min margin"
                 f" {cert['min_margin_bits']} bits —"
                 f" {'ok' if cert['ok'] else 'FAIL'}",
+                file=sys.stderr,
+            )
+
+    if run_memory:
+        from .memory import certify_memory
+        from .memory import write_cert as write_mcert
+
+        kw = {}
+        if args.batches:
+            kw["batches"] = tuple(args.batches)
+        mcert = certify_memory(graphs=args.graphs, **kw)
+        out = args.memory_cert_out
+        if out is None:
+            out = os.path.join(_repo_root(), "MEMORY_CERT.json")
+        if out != "-":
+            write_mcert(mcert, out)
+        report["memory"] = {
+            "ok": mcert["ok"],
+            "n_rows": mcert["n_rows"],
+            "n_failed": mcert["n_failed"],
+            "tiers": sorted(mcert["tiers"]),
+            "default_tier": mcert["default_tier"],
+            "peaks": mcert["peaks"],
+            "planner": mcert["planner"],
+            "failed_rows": [r for r in mcert["rows"] if not r["ok"]],
+            "cert_path": None if out == "-" else out,
+        }
+        if not mcert["ok"]:
+            report["ok"] = False
+            rc = 1
+        if not args.json:
+            for r in mcert["rows"]:
+                if not r["ok"]:
+                    print(f"OVER-BUDGET {r}", file=sys.stderr)
+            print(
+                f"memory: {mcert['n_rows']} row(s),"
+                f" {mcert['n_failed']} over budget,"
+                f" tiers {'/'.join(sorted(mcert['tiers']))} —"
+                f" {'ok' if mcert['ok'] else 'FAIL'}",
                 file=sys.stderr,
             )
 

@@ -302,7 +302,7 @@ def graph_registry(batch: int) -> list[tuple]:
         # reduction and the on-device MSM bit extraction. Each records its
         # kzg.fr_* obligations (conv exactness, u64 accumulator headroom,
         # fold-table coverage) via fq._cert at trace time, under every conv
-        # backend the five-pass CLI sweeps.
+        # backend the six-pass CLI sweeps.
         ("kzg.fr_mul", frops.fr_mul, (e1, e1)),
         ("kzg.fr_dot", frops.fr_dot, (s(4, 25), s(4, 25))),
         ("kzg.fr_weighted_sum",
@@ -318,7 +318,7 @@ def graph_registry(batch: int) -> list[tuple]:
         # masked committee aggregation (point_sum over the gathered cache),
         # the fused groupcheck+scaling pass and the B+1-pair Miller product
         # all record their obligations via fq._cert at trace time, under
-        # every conv backend the five-pass CLI sweeps. Cache rows use a
+        # every conv backend the six-pass CLI sweeps. Cache rows use a
         # small committee (C=8): the bound walk is per-lane, independent of
         # the committee/period extents, so the mainnet C=512 shape proves
         # the same obligations.

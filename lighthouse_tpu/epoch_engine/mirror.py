@@ -192,6 +192,7 @@ class RegistryMirror:
         else:
             j.reset(n)
         self.stats.full_syncs += 1
+        self._set_resident_gauge()
 
     def _regrow(self, n: int) -> None:
         new_pad = bucket(n)
@@ -201,6 +202,14 @@ class RegistryMirror:
             self.shadow[name] = col
             self.device[name] = self._put(col)
         self.n_pad = new_pad
+        self._set_resident_gauge()
+
+    def _set_resident_gauge(self) -> None:
+        from ..utils import metrics
+
+        metrics.EPOCH_MIRROR_BYTES.set(
+            sum(col.nbytes for col in self.shadow.values())
+        )
 
     def _apply_rows(self, vs, rows: list[int]) -> None:
         idx = np.asarray(rows, dtype=np.int64)

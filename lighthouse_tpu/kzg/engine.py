@@ -204,6 +204,11 @@ class CellEngine:
         neg_d_g2 = chain_plans.run_point_chains(2, gens, schedule)
         t2_proj = jnp.broadcast_to(jnp.asarray(t2), neg_d_g2.shape)
         self._z2_tab = np.asarray(curve.point_add(2, t2_proj, neg_d_g2))
+        from ..utils import metrics
+
+        metrics.KZG_TABLE_BYTES.set(
+            sum(a.nbytes for a in self._tables) + self._z2_tab.nbytes
+        )
         return self._tables
 
     # -- jitted graphs ------------------------------------------------------

@@ -129,6 +129,9 @@ class LcEngine:
                 stacked = np.concatenate([stacked, pad])
             self._cache = jnp.asarray(stacked)
             self._cache_rows = p_pad
+            from ..utils import metrics
+
+            metrics.LC_COMMITTEE_CACHE_BYTES.set(stacked.nbytes)
         return self._cache
 
     # -- jitted stages ------------------------------------------------------

@@ -107,6 +107,47 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# VMEM accounting sink (analysis/memory.py, pass 6): with a list installed,
+# every per-trace kernel launch records its tile signature + estimated
+# per-grid-step VMEM working set. The hook lives HERE, at the host wrapper
+# level, because _build_call is lru_cached — a hook inside it would fire
+# once per static signature ever, not once per trace the certifier runs.
+_VMEM_SINK: list | None = None
+
+
+def _record_vmem(tile: int, L: int, rows_p: int, out_key) -> None:
+    if _VMEM_SINK is None:
+        return
+    if out_key is not None:
+        R, mpos_np, mneg_np, oconst_np, n_pass, pass_w = _OUT_TABLE[out_key]
+        n_rows_out = R
+        const_b = mpos_np.nbytes
+        if bool(mneg_np.any()):
+            const_b += mneg_np.nbytes + oconst_np.nbytes
+    else:
+        n_rows_out, n_pass, pass_w = L, 0, 0
+        const_b = 0
+    const_b += _SHEAR_NP.nbytes + _FOLD8_NP.nbytes
+    blocks_in = 2 * tile * L * _D * 4
+    if n_pass:
+        blocks_in += tile * n_pass * pass_w * 4
+    block_out = tile * n_rows_out * _OUT_D * 4
+    # the in-kernel digit outer product dominates (_row_tile budgets ~4 MiB
+    # for it); grid-blocked operands double-buffer across grid steps
+    prod = tile * L * _D * _D * 4
+    _VMEM_SINK.append({
+        "tile": tile,
+        "lanes": L,
+        "grid": rows_p // tile,
+        "n_rows_out": n_rows_out,
+        "n_pass": n_pass,
+        "block_bytes": blocks_in + block_out,
+        "const_bytes": const_b,
+        "outer_product_bytes": prod,
+        "est_vmem_bytes": prod + 2 * (blocks_in + block_out) + const_b,
+    })
+
+
 # --------------------------------------------------------------------------------------
 # Exact digit-domain bound state (the _RState twin for base-2^8 planes)
 # --------------------------------------------------------------------------------------
@@ -535,6 +576,7 @@ def _run_fused(A_d, B_d, pre_ops, out_key, post_ops, Ain_d=None):
             Ain_d = jnp.pad(
                 Ain_d, [(0, rows_p - rows)] + [(0, 0)] * (Ain_d.ndim - 1)
             )
+    _record_vmem(tile, L, rows_p, out_key)
     run = _build_call(
         rows_p, tile, L, tuple(pre_ops), out_key, tuple(post_ops), _interpret()
     )

@@ -129,9 +129,13 @@ class FaultRecord:
     rung: str = ""
     attempt: int = 1
     ts: float = field(default_factory=time.time)
+    # OOM faults only: the static-memory model's view of the faulting
+    # domain (certified peak bytes, live residency gauge, tier margin) —
+    # a demotion report says what the planner predicted
+    memory: dict | None = None
 
     def as_dict(self) -> dict:
-        return {
+        d = {
             "stage": self.stage,
             "kind": self.kind.value,
             "error": self.error,
@@ -140,6 +144,9 @@ class FaultRecord:
             "attempt": self.attempt,
             "ts": self.ts,
         }
+        if self.memory is not None:
+            d["memory"] = self.memory
+        return d
 
 
 _LOG_DEPTH = 512
@@ -162,9 +169,17 @@ def record_fault(
     err = (
         f"{type(exc).__name__}: {exc}" if isinstance(exc, BaseException) else str(exc)
     )
+    mem = None
+    if kind is FaultKind.OOM:
+        try:
+            from ..analysis.memory import fault_memory_context
+
+            mem = fault_memory_context(domain or stage)
+        except Exception:  # noqa: BLE001 — enrichment never fails a record
+            mem = None
     rec = FaultRecord(
         stage=stage, kind=kind, error=err[:500], domain=domain, rung=rung,
-        attempt=attempt,
+        attempt=attempt, memory=mem,
     )
     with _log_lock:
         _log.append(rec)

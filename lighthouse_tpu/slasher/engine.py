@@ -250,6 +250,11 @@ class SpanStore:
 
     def _upload(self) -> None:
         self.dev = [self._put(a) for a in self.host]
+        from ..utils import metrics
+
+        metrics.SLASHER_SPAN_PLANE_BYTES.set(
+            sum(a.nbytes for a in self.host)
+        )
 
     def _try_upload(self) -> bool:
         """Upload with the fault recorded instead of raised (regrow /

@@ -360,3 +360,27 @@ STORE_FREEZE_TIMES = REGISTRY.histogram(
     "store_beacon_state_freeze_seconds",
     "Cold-migration time per state (store/src/metrics.rs)",
 )
+EPOCH_MIRROR_BYTES = REGISTRY.gauge(
+    "epoch_mirror_bytes",
+    "Device-resident bytes of the epoch-engine registry mirror columns, "
+    "set at every (re)grow/full-gather (epoch_engine/mirror.py; the static "
+    "twin is analysis.memory.epoch_mirror_bytes)",
+)
+SLASHER_SPAN_PLANE_BYTES = REGISTRY.gauge(
+    "slasher_span_plane_bytes",
+    "Device-resident bytes of the slasher span planes (min/max distance + "
+    "vote history), set at every capacity regrow/upload (slasher/engine.py; "
+    "static twin analysis.memory.slasher_span_bytes)",
+)
+LC_COMMITTEE_CACHE_BYTES = REGISTRY.gauge(
+    "lc_committee_cache_bytes",
+    "Device-resident bytes of the light-client per-period committee cache, "
+    "set at every cache rebuild (light_client/engine.py; static twin "
+    "analysis.memory.lc_committee_cache_bytes)",
+)
+KZG_TABLE_BYTES = REGISTRY.gauge(
+    "kzg_table_bytes",
+    "Device-resident bytes of the KZG cell-verification tables, set when "
+    "the CellEngine lazily builds them (kzg/engine.py; static twin "
+    "analysis.memory.kzg_table_bytes)",
+)

@@ -52,7 +52,7 @@ class TestCertifier:
         backends (acceptance criterion). Batch 32 exercises the f64-walk
         dispatch regime; the u64-walk regime is covered below. Slow lane:
         the full sweep re-derives every obligation (~2.5 min); tier-1 keeps
-        the memoized five-pass CLI gate plus the per-module subset tests."""
+        the memoized six-pass CLI gate plus the per-module subset tests."""
         cert = bounds.certify(backends=("f64", "digits"), batches=(32,))
         bad = [r for r in cert["obligations"] if not r["ok"]]
         assert cert["ok"] and not bad, bad[:5]
@@ -679,25 +679,26 @@ class TestLockdepRuntime:
 
 
 # =============================================================================
-# the five-pass CLI suite, end to end (ISSUE 9 CI satellite)
+# the six-pass CLI suite, end to end (ISSUE 9 CI satellite)
 # =============================================================================
 
 
 @pytest.mark.kernel
-class TestFivePassSuite:
+class TestSixPassSuite:
     def test_cli_green_certificate(self, tmp_path):
-        """``python -m lighthouse_tpu.analysis --json`` runs all five passes
-        (bounds, hygiene, recompile, supervisor, concurrency) end to end and
-        the certificate is green — a red cert fails tier-1, which is exactly
-        what keeps the hunter preflight (memoized per HEAD) honest. The
-        bounds pass is restricted to a representative graph subset at batch
-        1 to stay inside the tier-1 wall clock; the full obligation sweep is
-        TestCertifier's job."""
+        """``python -m lighthouse_tpu.analysis --json`` runs all six passes
+        (bounds, hygiene, recompile, supervisor, concurrency, memory) end to
+        end and the certificate is green — a red cert fails tier-1, which is
+        exactly what keeps the hunter preflight (memoized per HEAD) honest.
+        The bounds + memory passes are restricted to a representative graph
+        subset at batch 1 to stay inside the tier-1 wall clock; the full
+        sweeps are TestCertifier's / TestMemoryCertifier's job."""
         import subprocess
         import sys
 
         bounds_out = tmp_path / "BOUNDS_CERT.json"
         cc_out = tmp_path / "CONCURRENCY_CERT.json"
+        mem_out = tmp_path / "MEMORY_CERT.json"
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.run(
             [
@@ -706,6 +707,7 @@ class TestFivePassSuite:
                 "--batches", "1",
                 "--cert-out", str(bounds_out),
                 "--concurrency-cert-out", str(cc_out),
+                "--memory-cert-out", str(mem_out),
             ],
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             env=env, capture_output=True, text=True, timeout=600,
@@ -716,16 +718,27 @@ class TestFivePassSuite:
         rep = _json.loads(proc.stdout.strip().splitlines()[-1])
         assert rep["ok"]
         for pass_name in (
-            "bounds", "lint", "recompile", "supervisor", "concurrency"
+            "bounds", "lint", "recompile", "supervisor", "concurrency",
+            "memory",
         ):
             assert pass_name in rep, rep.keys()
             assert rep[pass_name]["ok"], rep[pass_name]
         assert rep["bounds"]["n_obligations"] > 0
         assert rep["concurrency"]["n_lock_classes"] >= 20
-        # both certificates landed where asked
-        assert bounds_out.exists() and cc_out.exists()
+        # all three certificates landed where asked
+        assert bounds_out.exists() and cc_out.exists() and mem_out.exists()
         cc = _json.loads(cc_out.read_text())
         assert cc["ok"] and cc["cycles"] == []
+        mc = _json.loads(mem_out.read_text())
+        assert mc["ok"] and mc["n_failed"] == 0
+        # the restricted run still covers all three conv backends, every
+        # residency family, and emits the planner the hunter gate consumes
+        regimes = {
+            r["graph"].split("/", 1)[0]
+            for r in mc["rows"] if r["kind"] == "graph_footprint"
+        }
+        assert {"f64@b1", "digits@b1", "pallas@b1"} <= regimes
+        assert rep["memory"]["planner"]["tpu_v5e"]
 
 
 _EXC_ANN_MODULE = textwrap.dedent(
@@ -882,3 +895,330 @@ class TestDurabilityLint:
         assert not findings, "\n".join(str(f) for f in findings)
         assert suppressed == 0
         assert durability.load_baseline() == set()
+
+
+# =============================================================================
+# Pass 6 — device-memory certifier & footprint planner (ISSUE 20)
+# =============================================================================
+
+from lighthouse_tpu.analysis import memory as amem  # noqa: E402
+
+# representative tier-1 subset: one fq graph, one tower graph, the fused
+# pallas entries (exercises the VMEM sink). The full sweep rides the slow
+# lane + the hunter preflight.
+_MEM_GRAPHS = ["fq.mont_mul", "tower.fq2_mul", "pallas.fused_mul"]
+
+
+@pytest.mark.kernel
+class TestMemoryCertifier:
+    def test_restricted_cert_green_all_three_backends(self):
+        """Clean tree: the representative subset certifies under all three
+        conv backends with every row kind present — graph footprints with
+        arg/out/temp/peak bytes + per-tier margins, pallas VMEM tile rows,
+        and all five subsystem residency families."""
+        cert = amem.certify_memory(
+            backends=("f64", "digits", "pallas"), batches=(1,),
+            graphs=_MEM_GRAPHS,
+        )
+        bad = [r for r in cert["rows"] if not r["ok"]]
+        assert cert["ok"] and cert["n_failed"] == 0, bad[:5]
+        kinds = {r["kind"] for r in cert["rows"]}
+        assert {"graph_footprint", "vmem_tile", "residency"} <= kinds
+        regimes = {
+            r["graph"].split("/", 1)[0]
+            for r in cert["rows"] if r["kind"] == "graph_footprint"
+        }
+        assert {"f64@b1", "digits@b1", "pallas@b1"} <= regimes
+        fams = [r["graph"] for r in cert["rows"] if r["kind"] == "residency"]
+        for fam in ("epoch_mirror", "slasher_spans", "lc_committee_cache",
+                    "kzg_tables", "firehose_staging"):
+            assert any(fam in g for g in fams), f"no residency row for {fam}"
+        row = next(r for r in cert["rows"] if r["kind"] == "graph_footprint")
+        for k in ("arg_bytes", "out_bytes", "temp_bytes", "peak_bytes",
+                  "min_tier", "margin_bytes"):
+            assert k in row, row
+        assert row["peak_bytes"] >= row["arg_bytes"] + row["out_bytes"]
+        # the certified clean-tree VMEM tiles all fit the declared caps
+        vrows = [r for r in cert["rows"] if r["kind"] == "vmem_tile"]
+        assert vrows
+        assert all(r["est_vmem_bytes"] <= 16 * 2**20 for r in vrows)
+        # XLA's lowered-computation cost analysis cross-checks the
+        # representative allowlist rows
+        assert any("xla_bytes_accessed" in r for r in cert["rows"])
+
+    @pytest.mark.slow
+    def test_full_cert_every_registry_graph(self):
+        """The acceptance sweep: EVERY bounds-registry graph certifies under
+        all three backends x both batch regimes (the hunter preflight's
+        default-on configuration)."""
+        cert = amem.certify_memory()
+        bad = [r for r in cert["rows"] if not r["ok"]]
+        assert cert["ok"] and cert["n_failed"] == 0, bad[:5]
+        covered = {
+            r["graph"].split("/", 1)[1]
+            for r in cert["rows"] if r["kind"] == "graph_footprint"
+        }
+        registry = {name for name, _, _ in bounds.graph_registry(1)}
+        assert registry <= covered, registry - covered
+
+    def test_mutation_widened_plane_fails(self, monkeypatch):
+        """Seeded over-budget mutation #1: a widened slasher span plane
+        (LIGHTHOUSE_SLASHER_HISTORY at 2^24 epochs — ~128 TB at 1M
+        validators) must turn the cert red on its residency row."""
+        monkeypatch.setenv("LIGHTHOUSE_SLASHER_HISTORY", str(1 << 24))
+        cert = amem.certify_memory(
+            backends=("f64",), batches=(1,), graphs=["fq.mont_mul"]
+        )
+        bad = [r for r in cert["rows"] if not r["ok"]]
+        assert not cert["ok"]
+        assert any("slasher_spans" in r["graph"] for r in bad), bad
+        assert all(r["min_tier"] is None for r in bad)
+
+    def test_mutation_unbounded_pad_fails(self):
+        """Seeded over-budget mutation #2: an unbounded pad (a graph
+        materializing a 1 TiB temp) fits no declared finite tier and fails
+        exactly like a tripped bound."""
+        def padded(x):
+            return jnp.zeros((1 << 38,), jnp.uint32)  # 2^40 B = 1 TiB
+
+        rows = amem.certify_graph_callable(
+            padded, (jax.ShapeDtypeStruct((1,), jnp.uint32),)
+        )
+        assert rows and not rows[0]["ok"]
+        assert rows[0]["min_tier"] is None
+        assert all(m < 0 for m in rows[0]["margin_bytes"].values())
+
+    def test_mutation_oversized_vmem_tile_fails(self, monkeypatch):
+        """Seeded over-budget mutation #3: an undeclared-tier pallas tile
+        (row tile forced to 2048 — a ~24 MB in-kernel working set vs the
+        declared 16 MiB VMEM cap) must turn the cert red on its VMEM
+        row."""
+        from lighthouse_tpu.ops.bls import pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "_row_tile", lambda rows, L: 2048)
+        cert = amem.certify_memory(
+            backends=("pallas",), batches=(1,), graphs=["pallas.fused_mul"]
+        )
+        bad = [
+            r for r in cert["rows"]
+            if not r["ok"] and r["kind"] == "vmem_tile"
+        ]
+        assert bad and not cert["ok"]
+        assert all(r["est_vmem_bytes"] > 16 * 2**20 for r in bad)
+
+    def test_planner_monotone_in_tier(self):
+        """max_safe_shape is monotone: a larger tier certifies a batch at
+        least as large, for every certified graph."""
+        cert = amem.certify_memory(
+            backends=("f64",), batches=(1, 32), graphs=["fq.mont_mul"]
+        )
+        order = ["tpu_v5e", "tpu_v4", "tpu_v5p", "cpu_proxy"]
+        assert cert["peaks"]
+        for graph in cert["peaks"]:
+            batches = [
+                amem.max_safe_shape(graph, tier, cert=cert) for tier in order
+            ]
+            assert all(b is not None for b in batches), (graph, batches)
+            assert batches == sorted(batches), (graph, batches)
+
+    def test_rung_fit_gates_oversized_shapes(self, monkeypatch):
+        """The hunter's gate arithmetic: a 1M-validator slasher rung at the
+        reference 4096-epoch history (~32 GB of span planes) cannot fit the
+        16 GiB tpu_v5e tier, while the 32k rung fits with margin."""
+        monkeypatch.setenv("BENCH_SLASHER_HISTORY", "4096")
+        v = amem.rung_fit("slasher", 0, 0, 1_048_576, 0, tier="tpu_v5e")
+        assert not v["fits"] and v["margin_bytes"] < 0
+        assert v["domain"] == "slasher"
+        v2 = amem.rung_fit("slasher", 0, 0, 32_768, 0, tier="tpu_v5e")
+        assert v2["fits"] and v2["margin_bytes"] > 0
+        # unbounded CPU proxy never blocks
+        v3 = amem.rung_fit("slasher", 0, 0, 1_048_576, 0, tier="cpu_proxy")
+        assert v3["fits"] and v3["cap_bytes"] is None
+
+    def test_oom_fault_record_carries_memory_context(self):
+        """Satellite: an oom-classified fault record is enriched with the
+        faulting domain's static-memory context (tier cap + margins), so a
+        demotion report says what the model predicted."""
+        from lighthouse_tpu.resilience import faults
+
+        rec = faults.record_fault(
+            "slasher.sweep", MemoryError("RESOURCE_EXHAUSTED"),
+            domain="slasher_device",
+        )
+        assert rec.kind is faults.FaultKind.OOM
+        assert rec.memory is not None
+        assert rec.memory["tier_hbm_bytes"] == amem.DEVICE_TIERS[
+            amem.DEFAULT_TIER
+        ]["hbm_bytes"]
+        assert rec.as_dict()["memory"] == rec.memory
+        # non-OOM faults stay unenriched
+        rec2 = faults.record_fault(
+            "slasher.sweep", RuntimeError("UNAVAILABLE: reset by peer"),
+            domain="slasher_device",
+        )
+        assert rec2.memory is None and "memory" not in rec2.as_dict()
+
+
+@pytest.mark.kernel
+class TestResidencyParity:
+    """The five static resident_bytes models vs the subsystems' ACTUAL
+    device_put accounting — the cert's residency rows are only as good as
+    these formulas."""
+
+    def test_pow2_bucket_twins_every_allocation_site(self):
+        from lighthouse_tpu.epoch_engine import kernels as ek
+        from lighthouse_tpu.firehose import sharding as fs
+        from lighthouse_tpu.slasher import engine as se
+
+        for n in (1, 7, 255, 256, 257, 5000, 262_144, 1_048_576):
+            assert amem._pow2_bucket(n, 256) == ek.bucket(n)
+            assert amem._pow2_bucket(n, 256) == se._bucket(n, 256)
+            assert amem._pow2_bucket(n, 4) == fs._bucket(n, floor=4)
+
+    def test_epoch_mirror_vs_device_put_accounting(self):
+        """A real full gather uploads EXACTLY the modeled registry-column
+        bytes (MirrorStats counts device_put nbytes), and the residency
+        gauge lands on the same figure."""
+        from types import SimpleNamespace
+
+        from lighthouse_tpu.epoch_engine.kernels import FAR_FUTURE_EPOCH
+        from lighthouse_tpu.epoch_engine.mirror import RegistryMirror
+        from lighthouse_tpu.utils import metrics
+
+        vs = [
+            SimpleNamespace(
+                effective_balance=32_000_000_000, slashed=False,
+                activation_epoch=0, exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+                activation_eligibility_epoch=0,
+                withdrawal_credentials=b"\x01" + bytes(31),
+            )
+            for _ in range(5)
+        ]
+        state = SimpleNamespace(validators=vs)
+        m = RegistryMirror()
+        m._full_gather(state, len(vs))
+        want = amem.epoch_mirror_bytes(5, include_epoch_planes=False)
+        assert m.stats.host_to_device_bytes == want
+        assert [v for _, _, v in metrics.EPOCH_MIRROR_BYTES.collect()] == [
+            want
+        ]
+
+    def test_slasher_planes_vs_allocation(self):
+        """empty_planes_np allocates exactly the modeled bytes (the device
+        upload device_puts those same arrays)."""
+        from lighthouse_tpu.slasher import engine as se
+
+        for v, hist in ((1000, 64), (32_768, 4096)):
+            planes = se.empty_planes_np(se._bucket(v, 256), hist)
+            assert sum(p.nbytes for p in planes) == amem.slasher_span_bytes(
+                v, history=hist
+            )
+
+    def test_lc_committee_cache_vs_allocation(self):
+        """The model equals the nbytes of the exact array _cache_arr
+        device-transfers: [bucket(p, 4), 512, 3, 25] u64."""
+        from lighthouse_tpu.firehose.sharding import _bucket
+
+        for p in (1, 4, 5, 64):
+            arr = np.zeros((_bucket(p, floor=4), 512, 3, 25), np.uint64)
+            assert amem.lc_committee_cache_bytes(p) == arr.nbytes
+
+    def test_kzg_tables_vs_built_tables(self):
+        """The model equals the ACTUAL table bytes a CellEngine builds (the
+        tiny insecure-setup geometry pins every term, including the
+        [cells, 6, 25] z2 chain table), and the gauge lands on it."""
+        from lighthouse_tpu.kzg import Kzg
+        from lighthouse_tpu.kzg.cells import CellContext
+        from lighthouse_tpu.kzg.engine import CellEngine
+        from lighthouse_tpu.kzg.setup import insecure_setup
+        from lighthouse_tpu.utils import metrics
+
+        ctx = CellContext(Kzg(insecure_setup(16, n_g2=5)),
+                          cells_per_ext_blob=8)
+        eng = CellEngine(ctx)
+        tables = eng._build_tables()
+        got = sum(a.nbytes for a in tables) + eng._z2_tab.nbytes
+        assert got == amem.kzg_table_bytes(cells=ctx.cells, k=ctx.k)
+        assert [v for _, _, v in metrics.KZG_TABLE_BYTES.collect()] == [got]
+
+    def test_firehose_staging_vs_staged_arrays(self):
+        """stage_indexed_shards produces exactly the modeled per-tick
+        bytes across the arrays put_staged device-transfers."""
+        from lighthouse_tpu.bls import tpu_backend as tb
+
+        items = [[([0, 1, 2], b"msg-%d" % i, bytes(96)) for i in range(2)]]
+        staged = tb.stage_indexed_shards(items, shard_cap=4)
+        got = sum(
+            np.asarray(staged[k]).nbytes for k in tb._STAGED_SET_KEYS
+        )
+        assert got == amem.staged_tick_bytes(staged["n_pad"],
+                                             staged["k_pad"])
+        assert got == amem.firehose_staging_bytes(
+            max_batch=4, prep_depth=0, k_pad=staged["k_pad"]
+        )
+
+
+class TestBoundedCacheAudit:
+    def test_declared_cache_bounds_hold(self):
+        """Satellite: the existing bounded caches enforce their declared
+        bounds — the data-column/blob pending cache evicts past
+        MAX_PENDING, the early-attester cache is a single slot by
+        construction, and the LC update store's hot map prunes to keep."""
+        from lighthouse_tpu.beacon_chain.data_availability import (
+            DataAvailabilityChecker,
+        )
+        from lighthouse_tpu.beacon_chain.early_attester_cache import (
+            EarlyAttesterCache,
+        )
+        from lighthouse_tpu.light_client.update_store import (
+            LightClientUpdateStore,
+        )
+
+        da = DataAvailabilityChecker(spec=None)
+        assert da.MAX_PENDING == 64
+        with da._lock:
+            for i in range(da.MAX_PENDING + 16):
+                da._touch(i.to_bytes(32, "big"))
+            assert len(da._pending) == da.MAX_PENDING
+        eac = EarlyAttesterCache()
+        unbounded = {
+            k: v for k, v in vars(eac).items()
+            if isinstance(v, (dict, list, set))
+        }
+        assert not unbounded, unbounded
+        us = LightClientUpdateStore(spec=None)
+        us._best = {i: object() for i in range(100)}
+        assert us.prune_hot(8) == 92
+        assert len(us) == 8
+
+
+class TestHunterMemoryGate:
+    def test_unfittable_rung_skipped_with_logged_verdict(
+        self, monkeypatch, tmp_path
+    ):
+        """Acceptance: the hunter's fit-gate rejects a real ladder rung
+        whose shape cannot fit the declared tier, and the skip verdict
+        lands in the window log — the shape is never dispatched."""
+        import tools_tpu_hunter as hunter
+
+        monkeypatch.setenv("BENCH_SLASHER_HISTORY", "4096")
+        monkeypatch.setattr(hunter, "MEMORY_TIER", "tpu_v5e")
+        log_path = tmp_path / "TPU_WINDOW_LOG.jsonl"
+        monkeypatch.setattr(hunter, "LOG", str(log_path))
+        idx = next(
+            i for i, r in enumerate(hunter.RUNGS)
+            if r[5] == "slasher" and r[2] >= 1_000_000
+        )
+        verdict = hunter.rung_fit_verdict(idx)
+        assert not verdict["fits"], verdict
+        assert verdict["margin_bytes"] < 0
+        # the main loop's skip branch logs exactly this verdict
+        hunter.log("rung_skipped_unfittable", rung=idx, **verdict)
+        import json as _json
+
+        rec = _json.loads(log_path.read_text().splitlines()[-1])
+        assert rec["event"] == "rung_skipped_unfittable"
+        assert rec["rung"] == idx and rec["fits"] is False
+        # the smallest rung still passes the gate (a window is spent)
+        assert hunter.rung_fit_verdict(0)["fits"]

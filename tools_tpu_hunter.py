@@ -75,11 +75,15 @@ RECORDS = os.path.join(CACHE, "tpu_records.jsonl")
 
 PROBE_PERIOD_S = float(os.environ.get("HUNTER_PERIOD", "420"))
 PROBE_TIMEOUT_S = float(os.environ.get("HUNTER_PROBE_TIMEOUT", "120"))
-# 900s: the five-pass preflight now certifies THREE conv backends (the
-# pallas regime re-traces the whole graph surface through the fused
-# kernels — bounds alone is ~4.5 min on this box); memoized per HEAD, so
-# the cost is paid once per commit, never per window
-PREFLIGHT_TIMEOUT_S = float(os.environ.get("HUNTER_PREFLIGHT_TIMEOUT", "900"))
+# 1800s: the six-pass preflight certifies THREE conv backends twice over —
+# the bounds pass AND the memory pass each re-trace the whole graph surface
+# (bounds alone is ~4.5 min on this box); memoized per HEAD, so the cost is
+# paid once per commit, never per window
+PREFLIGHT_TIMEOUT_S = float(os.environ.get("HUNTER_PREFLIGHT_TIMEOUT", "1800"))
+# Device tier the rung fit-gate checks shapes against (ISSUE 20): rungs
+# whose predicted footprint exceeds this tier's HBM are skipped with a
+# logged verdict instead of dispatched into a silent device OOM
+MEMORY_TIER = os.environ.get("HUNTER_MEMORY_TIER", "tpu_v5e")
 
 # bench._LADDER reversed: smallest first — land ANY TPU record, then climb.
 # Timeouts get +50% slack over bench's (a window may open mid-compile).
@@ -188,11 +192,13 @@ def probe() -> str | None:
 
 # ISSUE 5 preflight: a TPU window must never be spent benching a kernel tree
 # that fails static certification (limb-bound proofs / trace-hygiene lint /
-# concurrency cert — a racy or deadlock-prone host pipeline wastes a window
-# just as surely as a bad kernel).
+# concurrency cert / memory cert — a racy, deadlock-prone or over-budget
+# host pipeline wastes a window just as surely as a bad kernel).
 # Memoized per git HEAD — the daemon outlives commits, so a new HEAD re-runs
 # the analysis; a definitive verdict (clean/dirty) sticks for that HEAD.
-_preflight: dict = {"head": None, "ok": None}
+# "memory" caches the memory pass's report (peak table + planner) so the
+# per-rung fit-gate reads the freshly certified model, not a stale file.
+_preflight: dict = {"head": None, "ok": None, "memory": None}
 
 
 def kernels_certified() -> bool:
@@ -222,6 +228,7 @@ def kernels_certified() -> bool:
             "min_margin_bits": rep.get("bounds", {}).get("min_margin_bits"),
             "concurrency_findings": rep.get("concurrency", {}).get("n_findings"),
             "lock_cycles": len(rep.get("concurrency", {}).get("cycles", [])),
+            "memory_findings": rep.get("memory", {}).get("n_failed"),
         }
     except (ValueError, IndexError):
         # no parseable report: a clean exit makes no sense, and a nonzero
@@ -231,8 +238,27 @@ def kernels_certified() -> bool:
         return False
     log("preflight_ok" if ok else "preflight_failed",
         seconds=dt, head=head, **summary)
-    _preflight.update(head=head, ok=ok)
+    _preflight.update(head=head, ok=ok, memory=rep.get("memory"))
     return ok
+
+
+def rung_fit_verdict(rung_idx: int) -> dict:
+    """Static fit verdict for one ladder rung against MEMORY_TIER (ISSUE
+    20): pure arithmetic over the preflight's certified peak table + the
+    residency models — never touches the device tunnel. On any error the
+    rung is dispatched (a broken gate must not strand the ladder)."""
+    try:
+        from lighthouse_tpu.analysis import memory as amem
+
+        sets, keys, validators, batch, _timeout, mode = RUNGS[rung_idx]
+        cert = _preflight.get("memory") or amem._load_cert()
+        return amem.rung_fit(
+            mode, sets, keys, validators, batch,
+            tier=MEMORY_TIER, cert=cert,
+        )
+    except Exception as e:  # noqa: BLE001 — the gate is advisory
+        return {"fits": True, "tier": MEMORY_TIER,
+                "gate_error": f"{type(e).__name__}: {e}"}
 
 
 def load_state() -> dict:
@@ -366,6 +392,16 @@ def main() -> None:
                         # starting a rung now would corrupt its measurement
                         log("rung_skipped_bench_in_progress")
                         break
+                    verdict = rung_fit_verdict(cursor)
+                    if not verdict.get("fits", True):
+                        # the static planner says this shape cannot fit the
+                        # declared tier: dispatching it would burn the rest
+                        # of the window on a silent device OOM. Skip it with
+                        # a logged verdict; the persistent next_rung cursor
+                        # stays put (a different tier / HEAD may fit later).
+                        log("rung_skipped_unfittable", rung=cursor, **verdict)
+                        cursor += 1
+                        continue
                     rec, fault_kind = run_rung(cursor)
                     if rec is None:
                         key = str(cursor)
